@@ -1,0 +1,34 @@
+"""introspective_awareness_tpu — a TPU-native (JAX/XLA/Pallas/pjit) framework for the
+"injected thoughts" introspective-awareness evaluation.
+
+Re-implements the capabilities of the reference harness
+(`tim-hua-01/introspective-awareness`, see SURVEY.md) with a TPU-first design:
+
+- The intervened forward pass (activation capture + steering-vector injection) is
+  traced into XLA: layer index and strength are *runtime operands*, so one compiled
+  executable serves the entire model x layer x strength x concept sweep
+  (replaces PyTorch forward hooks, reference model_utils.py:293-879).
+- Models are first-party JAX decoder implementations (Llama/Qwen/Gemma/MoE
+  families) loading HF safetensors directly into GSPMD-sharded parameters over a
+  `jax.sharding.Mesh` (replaces transformers + accelerate `device_map="auto"`).
+- Trials shard over the mesh `data` axis; weights over the `model` axis; MoE
+  experts over the `expert` axis; collectives ride ICI via GSPMD propagation
+  (replaces NCCL-behind-torch, reference pyproject.toml:22).
+- The LLM judge runs either against the OpenAI API (reference behavior,
+  eval_utils.py:236-769) or co-resident on-TPU as a second model on the mesh.
+
+Package layout (SURVEY.md §7.2):
+
+- ``parallel``  — mesh construction, PartitionSpec rules, host<->device IO
+- ``models``    — configs, registry, pure-JAX transformer, tokenizer/chat templates
+- ``runtime``   — intervened forward, KV cache, prefill+decode, sampling
+- ``ops``       — attention (XLA + Pallas flash), fused steering, ring attention
+- ``vectors``   — concept-vector extraction strategies, baseline data, vector IO
+- ``protocol``  — introspection prompts, trial runners, keyword detection
+- ``judge``     — grading criteria, OpenAI client, on-TPU grader, batch grading
+- ``metrics``   — signal-detection metrics, results persistence, plots, transcripts
+- ``training``  — next-token loss + optimizer step (sharded), for probes/finetunes
+- ``cli``       — argparse sweep orchestrator with artifact-based resume
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,34 @@
+"""Mesh + sharding: the distributed-communication backend of the framework.
+
+Reference equivalent: none first-party — the reference delegates multi-GPU entirely
+to HF accelerate ``device_map="auto"`` (model_utils.py:107) with NCCL as a transitive
+torch wheel (pyproject.toml:22). Here the mesh/sharding module is a first-class
+component (SURVEY.md §5.8): all communication is XLA-inserted ICI/DCN collectives
+derived from GSPMD sharding propagation.
+"""
+
+from introspective_awareness_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    local_mesh,
+    mesh_axis_sizes,
+)
+from introspective_awareness_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_to_sharding,
+    shard_params,
+    replicated,
+    with_sharding_constraint,
+)
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "local_mesh",
+    "mesh_axis_sizes",
+    "ShardingRules",
+    "logical_to_sharding",
+    "shard_params",
+    "replicated",
+    "with_sharding_constraint",
+]

@@ -1,0 +1,104 @@
+"""Device-mesh construction for the introspection eval.
+
+The sweep is embarrassingly parallel over trials (data axis), tensor-parallel over
+attention heads / MLP hidden (model axis), and expert-parallel for MoE subjects
+(expert axis folded into the model axis by default). A fourth logical axis,
+``seq``, backs ring-attention context parallelism for long-context grading.
+
+Axis semantics (SURVEY.md §2.3):
+
+- ``data``   — DP: trial batches shard here; the primary scaling axis of the eval.
+- ``model``  — TP: attention heads / MLP hidden / vocab shard here (ICI all-reduce).
+- ``expert`` — EP: MoE experts shard here (defaults to size 1; fold into model TP
+  when the subject is dense).
+- ``seq``    — SP/CP: ring-attention sequence sharding (defaults to size 1).
+
+Pipeline parallelism is intentionally not a default axis: over ICI, TP dominates PP
+for the decoder sizes in BASELINE.json; a stage-split path can be layered on later
+without changing this module's API (SURVEY.md §2.3 "PP").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+EXPERT_AXIS = "expert"
+SEQ_AXIS = "seq"
+
+AXIS_ORDER = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Shape of the logical device mesh.
+
+    ``dp``/``tp``/``ep``/``sp`` are the axis sizes; any left as ``None`` is
+    inferred so that dp * tp * ep * sp == len(devices), with remaining devices
+    going to ``dp`` (the eval's primary scaling axis).
+    """
+
+    dp: int | None = None
+    tp: int | None = 1
+    ep: int | None = 1
+    sp: int | None = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        known = [x for x in (self.dp, self.tp, self.ep, self.sp) if x is not None]
+        prod = math.prod(known) if known else 1
+        n_none = sum(x is None for x in (self.dp, self.tp, self.ep, self.sp))
+        if n_none == 0:
+            if prod != n_devices:
+                raise ValueError(
+                    f"mesh {self.dp}x{self.ep}x{self.sp}x{self.tp} = {prod} "
+                    f"does not match {n_devices} devices"
+                )
+            return (self.dp, self.tp, self.ep, self.sp)
+        if n_devices % prod != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes product {prod}"
+            )
+        fill = n_devices // prod
+        # Exactly one unknown axis gets the remaining devices; extra unknowns get 1.
+        out = []
+        for x in (self.dp, self.tp, self.ep, self.sp):
+            if x is None:
+                out.append(fill)
+                fill = 1
+            else:
+                out.append(x)
+        return tuple(out)  # type: ignore[return-value]
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a 4-axis ``Mesh`` with axes (data, expert, seq, model).
+
+    The ``model`` axis is innermost so TP collectives ride the fastest ICI links;
+    ``data`` is outermost so DP gradients/metrics cross the slowest links (or DCN
+    in multi-slice deployments). This mirrors the standard TPU recipe: put the
+    highest-bandwidth-demand axis on the tightest physical neighborhood.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    dp, tp, ep, sp = config.resolve(len(devices))
+    arr = np.array(devices).reshape(dp, ep, sp, tp)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def local_mesh() -> Mesh:
+    """Single-device mesh (CPU smoke / one-chip runs): all axes size 1 except data."""
+    return build_mesh(MeshConfig(dp=None, tp=1, ep=1, sp=1))
+
+
+def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

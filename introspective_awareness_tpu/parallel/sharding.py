@@ -1,0 +1,101 @@
+"""Logical-axis sharding rules → ``NamedSharding`` over the mesh.
+
+Parameters and activations are annotated with *logical* axis names (e.g.
+``("layers", "embed", "q_heads")``); a ``ShardingRules`` table maps each logical
+axis to a mesh axis (or replication). GSPMD then propagates shardings and inserts
+the ICI collectives — the TPU-native replacement for the reference's
+accelerate layer placement + defensive cross-GPU ``.to(device)`` moves in its
+steering hooks (reference model_utils.py:107,384,604,770,801). A steering vector
+here is simply replicated (all logical axes → None) so it is resident wherever the
+residual stream is.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from introspective_awareness_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+)
+
+# Logical axis names used across models/ and runtime/.
+BATCH = "batch"
+SEQUENCE = "sequence"
+LAYERS = "layers"  # stacked-layer leading dim (scanned over; never sharded)
+EMBED = "embed"  # residual stream
+HEADS = "heads"  # attention query heads
+KV_HEADS = "kv_heads"
+HEAD_DIM = "head_dim"
+MLP = "mlp"  # MLP hidden
+VOCAB = "vocab"
+EXPERT = "expert"  # MoE expert dim
+UNSHARDED = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Map logical axis name → mesh axis name (or None = replicate).
+
+    The default table is the standard Megatron-style TP layout: query/kv heads and
+    MLP hidden shard over ``model``; embeddings shard over ``model`` on the vocab
+    dim; experts shard over ``expert``; batch shards over ``data``; sequence over
+    ``seq`` (ring attention). The residual (``embed``) stays replicated within a
+    TP group so layernorms and the steering add need no collectives.
+    """
+
+    rules: Mapping[str, str | None] = dataclasses.field(
+        default_factory=lambda: {
+            BATCH: DATA_AXIS,
+            SEQUENCE: SEQ_AXIS,
+            LAYERS: None,
+            EMBED: None,
+            HEADS: MODEL_AXIS,
+            KV_HEADS: MODEL_AXIS,
+            HEAD_DIM: None,
+            MLP: MODEL_AXIS,
+            VOCAB: MODEL_AXIS,
+            EXPERT: EXPERT_AXIS,
+        }
+    )
+
+    def spec(self, logical_axes: tuple[str | None, ...]) -> P:
+        return P(*(self.rules.get(ax) if ax is not None else None for ax in logical_axes))
+
+
+def logical_to_sharding(
+    logical_axes: tuple[str | None, ...], mesh: Mesh, rules: ShardingRules
+) -> NamedSharding:
+    return NamedSharding(mesh, rules.spec(logical_axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_params(params: Any, axes: Any, mesh: Mesh, rules: ShardingRules) -> Any:
+    """Device-put a parameter pytree according to a parallel pytree of logical axes.
+
+    ``axes`` mirrors ``params``' structure; each leaf is a tuple of logical axis
+    names (same rank as the array). Arrays move host→device sharded, so no single
+    device materializes the full parameter (required for 70B+ checkpoints,
+    SURVEY.md §7.4.4).
+    """
+
+    def _put(x, ax):
+        return jax.device_put(x, logical_to_sharding(tuple(ax), mesh, rules))
+
+    return jax.tree.map(_put, params, axes, is_leaf=lambda x: x is None)
+
+
+def with_sharding_constraint(
+    x: jax.Array, logical_axes: tuple[str | None, ...], mesh: Mesh, rules: ShardingRules
+) -> jax.Array:
+    """Annotate an intermediate activation inside jit."""
+    return jax.lax.with_sharding_constraint(x, logical_to_sharding(logical_axes, mesh, rules))

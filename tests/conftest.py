@@ -1,0 +1,43 @@
+"""Test harness: fake an 8-device CPU mesh so multi-chip sharding is exercised
+without TPU hardware (SURVEY.md §4: XLA_FLAGS=--xla_force_host_platform_device_count).
+
+Must run before the first `import jax` anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the session's axon/TPU pin for tests
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep CPU compiles fast and deterministic in CI.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The container's sitecustomize registers the axon TPU backend at interpreter
+# start, before this conftest runs — force JAX back onto the virtual CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax._src.xla_bridge._clear_backends()
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from introspective_awareness_tpu.parallel import MeshConfig, build_mesh
+
+    return build_mesh(MeshConfig(dp=2, tp=4))
+
+
+@pytest.fixture(scope="session")
+def mesh1():
+    from introspective_awareness_tpu.parallel import MeshConfig, build_mesh
+    import jax
+
+    return build_mesh(MeshConfig(dp=1, tp=1), devices=jax.devices()[:1])
