@@ -12,6 +12,7 @@ from introspective_awareness_tpu.parallel.mesh import (
     build_mesh,
     local_mesh,
     mesh_axis_sizes,
+    single_device_mesh,
 )
 from introspective_awareness_tpu.parallel.sharding import (
     ShardingRules,
@@ -26,6 +27,7 @@ __all__ = [
     "build_mesh",
     "local_mesh",
     "mesh_axis_sizes",
+    "single_device_mesh",
     "ShardingRules",
     "logical_to_sharding",
     "shard_params",

@@ -41,8 +41,10 @@ class MeshConfig:
     """Shape of the logical device mesh.
 
     ``dp``/``tp``/``ep``/``sp`` are the axis sizes; any left as ``None`` is
-    inferred so that dp * tp * ep * sp == len(devices), with remaining devices
-    going to ``dp`` (the eval's primary scaling axis).
+    inferred so that dp * tp * ep * sp == len(devices). The *first* unspecified
+    axis in (dp, tp, ep, sp) order absorbs the remaining devices; any further
+    unspecified axes get size 1. With the default config (only ``dp`` is None)
+    the remainder therefore lands on ``dp``, the eval's primary scaling axis.
     """
 
     dp: int | None = None
@@ -88,16 +90,33 @@ def build_mesh(
     in multi-slice deployments). This mirrors the standard TPU recipe: put the
     highest-bandwidth-demand axis on the tightest physical neighborhood.
     """
-    devices = list(devices if devices is not None else jax.devices())
     config = config or MeshConfig()
-    dp, tp, ep, sp = config.resolve(len(devices))
-    arr = np.array(devices).reshape(dp, ep, sp, tp)
+    if devices is None:
+        # Topology-aware assignment: on real TPU slices, plain jax.devices()
+        # enumeration order does not guarantee the innermost 'model' axis lands
+        # on physically adjacent chips. create_device_mesh consults the slice
+        # topology so TP collectives actually ride neighbor ICI links.
+        dp, tp, ep, sp = config.resolve(len(jax.devices()))
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh((dp, ep, sp, tp))
+    else:
+        devices = list(devices)
+        dp, tp, ep, sp = config.resolve(len(devices))
+        arr = np.array(devices).reshape(dp, ep, sp, tp)
     return Mesh(arr, AXIS_ORDER)
 
 
 def local_mesh() -> Mesh:
-    """Single-device mesh (CPU smoke / one-chip runs): all axes size 1 except data."""
+    """Data-parallel-only default mesh: every device on the ``data`` axis
+    (tp=ep=sp=1). On a single chip or CPU this degenerates to a 1-device mesh."""
     return build_mesh(MeshConfig(dp=None, tp=1, ep=1, sp=1))
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """A true 1-device mesh (CPU smoke tests, one-chip debugging)."""
+    dev = device if device is not None else jax.devices()[0]
+    return build_mesh(MeshConfig(dp=1, tp=1, ep=1, sp=1), devices=[dev])
 
 
 def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:

@@ -66,7 +66,19 @@ class ShardingRules:
     )
 
     def spec(self, logical_axes: tuple[str | None, ...]) -> P:
-        return P(*(self.rules.get(ax) if ax is not None else None for ax in logical_axes))
+        out = []
+        for ax in logical_axes:
+            if ax is None:
+                out.append(None)
+            elif ax not in self.rules:
+                # A typo'd axis name must not silently replicate a large
+                # parameter — that shows up only as OOM/slowness much later.
+                raise KeyError(
+                    f"unknown logical axis {ax!r}; registered: {sorted(self.rules)}"
+                )
+            else:
+                out.append(self.rules[ax])
+        return P(*out)
 
 
 def logical_to_sharding(

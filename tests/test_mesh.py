@@ -54,13 +54,28 @@ def test_shard_params_places_shards(mesh8):
     np.testing.assert_array_equal(np.asarray(sharded["w"]), params["w"])
 
 
-def test_matmul_inserts_collective(mesh8):
-    """x @ w with w sharded on its contracting output dim runs under jit and
-    produces the right value — GSPMD inserts whatever collective is needed."""
+def test_unknown_logical_axis_raises():
     rules = ShardingRules()
+    with pytest.raises(KeyError):
+        rules.spec(("embedd",))  # typo must not silently replicate
+
+
+def test_single_device_mesh():
+    from introspective_awareness_tpu.parallel import single_device_mesh
+
+    mesh = single_device_mesh()
+    assert mesh.devices.size == 1
+
+
+def test_matmul_inserts_collective(mesh8):
+    """A TP matmul (w sharded on its contracting dim) must actually compile to
+    a cross-device collective, not just produce the right numbers."""
+    rules = ShardingRules()
+    # Shard the contracting dim of w over the model axis: y = x @ w requires an
+    # all-reduce (or reduce-scatter) of partial products across 'model'.
     w = shard_params(
         {"w": np.arange(64, dtype=np.float32).reshape(8, 8)},
-        {"w": (sh.EMBED, sh.MLP)},
+        {"w": (sh.MLP, sh.EMBED)},  # contracting dim 0 sharded over model
         mesh8,
         rules,
     )["w"]
@@ -68,9 +83,68 @@ def test_matmul_inserts_collective(mesh8):
 
     @jax.jit
     def f(x, w):
-        return x @ w
+        y = x @ w
+        # Pin the output replicated so the partial-sum reduction cannot be
+        # deferred past the function boundary.
+        return jax.lax.with_sharding_constraint(
+            y, jax.sharding.NamedSharding(mesh8, P())
+        )
 
+    hlo = f.lower(x, w).compile().as_text()
+    assert "all-reduce" in hlo or "reduce-scatter" in hlo, (
+        "expected a cross-device collective in compiled HLO"
+    )
     out = f(x, w)
     np.testing.assert_allclose(
         np.asarray(out), np.ones((2, 8)) @ np.arange(64).reshape(8, 8), rtol=1e-6
     )
+
+
+def test_shard_stacked_layer_pytree(mesh8):
+    """Shard a scanned stacked-layer pytree (leading LAYERS dim) — the shape the
+    model runtime actually uses."""
+    rules = ShardingRules()
+    L, H, M = 4, 8, 16
+    params = {
+        "layers": {
+            "wi": np.ones((L, H, M), np.float32),
+            "wo": np.ones((L, M, H), np.float32),
+            "norm": np.ones((L, H), np.float32),
+        }
+    }
+    axes = {
+        "layers": {
+            "wi": (sh.LAYERS, sh.EMBED, sh.MLP),
+            "wo": (sh.LAYERS, sh.MLP, sh.EMBED),
+            "norm": (sh.LAYERS, sh.EMBED),
+        }
+    }
+    sharded = shard_params(params, axes, mesh8, rules)
+    # LAYERS never sharded; MLP shards 4-way over 'model'.
+    assert {s.data.shape for s in sharded["layers"]["wi"].addressable_shards} == {
+        (L, H, M // 4)
+    }
+    assert {s.data.shape for s in sharded["layers"]["wo"].addressable_shards} == {
+        (L, M // 4, H)
+    }
+    assert {s.data.shape for s in sharded["layers"]["norm"].addressable_shards} == {
+        (L, H)
+    }
+
+
+def test_with_sharding_constraint_under_jit(mesh8):
+    """Annotating an intermediate activation inside jit propagates the sharding."""
+    rules = ShardingRules()
+    x = np.ones((8, 16), np.float32)
+
+    @jax.jit
+    def f(x):
+        y = x * 2.0
+        return sh.with_sharding_constraint(y, (sh.BATCH, sh.EMBED), mesh8, rules)
+
+    out = f(x)
+    # trailing Nones are normalized away by XLA
+    assert out.sharding.spec in (P("data"), P("data", None))
+    # batch dim split 2-way over 'data'
+    assert {s.data.shape for s in out.addressable_shards} == {(4, 16)}
+    np.testing.assert_array_equal(np.asarray(out), x * 2.0)
