@@ -1,0 +1,63 @@
+"""models/ — first-party JAX decoder families, configs, registry, tokenizers.
+
+Replaces the reference's L1 model runtime substrate (HF transformers +
+accelerate, model_utils.py) with owned model code: Llama 3.x / Qwen2.5 /
+Qwen3(+MoE) / Gemma-2/3 as one config-driven ``lax.scan`` transformer whose
+forward carries traced capture/steering operands (SURVEY.md §7.1-7.2).
+"""
+
+from introspective_awareness_tpu.models.config import (
+    ModelConfig,
+    RopeScaling,
+    config_from_hf,
+    tiny_config,
+)
+from introspective_awareness_tpu.models.registry import (
+    MODEL_NAME_MAP,
+    MODELS_WITHOUT_SYSTEM_ROLE,
+    PRE_QUANTIZED_MODELS,
+    get_layer_at_fraction,
+    resolve_model_name,
+)
+from introspective_awareness_tpu.models.tokenizer import (
+    ByteTokenizer,
+    HFTokenizer,
+    Tokenizer,
+    pad_batch,
+)
+from introspective_awareness_tpu.models.transformer import (
+    ForwardResult,
+    KVCache,
+    SteerSpec,
+    forward,
+    init_cache,
+    init_params,
+    make_positions,
+    no_steer,
+    param_logical_axes,
+)
+
+__all__ = [
+    "ModelConfig",
+    "RopeScaling",
+    "config_from_hf",
+    "tiny_config",
+    "MODEL_NAME_MAP",
+    "MODELS_WITHOUT_SYSTEM_ROLE",
+    "PRE_QUANTIZED_MODELS",
+    "get_layer_at_fraction",
+    "resolve_model_name",
+    "ByteTokenizer",
+    "HFTokenizer",
+    "Tokenizer",
+    "pad_batch",
+    "ForwardResult",
+    "KVCache",
+    "SteerSpec",
+    "forward",
+    "init_cache",
+    "init_params",
+    "make_positions",
+    "no_steer",
+    "param_logical_axes",
+]

@@ -1,0 +1,195 @@
+"""Model configuration for the first-party JAX decoder families.
+
+One config dataclass covers every architecture the reference loads through HF
+``transformers`` (reference model_utils.py:19-53): Llama 3.x, Qwen2.5 (qkv
+bias), Gemma-2/3 (logit softcaps, post-norms, sliding-window pattern, embed
+scaling), and Qwen3-style MoE (expert count / top-k). Owning the model code —
+instead of monkey-patching HF internals the way the reference must
+(model_utils.py:144-248) — means the architecture quirks are plain config
+flags here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """RoPE frequency scaling (config.json ``rope_scaling``).
+
+    ``kind="llama3"`` applies Llama-3's frequency-dependent smoothing;
+    ``kind="linear"`` divides all frequencies by ``factor`` (Gemma-3 global
+    layers use this with factor 8).
+    """
+
+    factor: float
+    kind: str = "llama3"
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int
+    hidden_size: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    mlp_hidden: int
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    qkv_bias: bool = False  # Qwen2.5
+    attn_logit_softcap: float | None = None  # Gemma-2
+    final_logit_softcap: float | None = None  # Gemma-2
+    use_post_norms: bool = False  # Gemma-2/3: extra norms after attn/mlp blocks
+    use_qk_norm: bool = False  # Gemma-3 / Qwen3: RMSNorm on q,k heads
+    embed_scale: bool = False  # Gemma: embeddings scaled by sqrt(hidden)
+    query_scale: float | None = None  # Gemma query_pre_attn_scalar; None = 1/sqrt(d)
+    sliding_window: int | None = None
+    # Every `pattern`-th layer is global; the rest use the sliding window
+    # (Gemma-2: pattern 2 = alternate; Gemma-3: pattern 6).
+    sliding_window_pattern: int = 2
+    norm_scale_plus_one: bool = False  # Gemma RMSNorm multiplies by (1 + w)
+    rope_scaling: RopeScaling | None = None
+    max_position: int = 8192
+    # MoE (0 experts = dense MLP)
+    n_experts: int = 0
+    n_experts_per_tok: int = 0
+    moe_mlp_hidden: int = 0
+    # Gemma-3 uses a different rope theta for local (sliding) layers
+    rope_theta_local: float | None = None
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    def layer_is_sliding(self, layer_idx: int) -> bool:
+        """Host-side helper (tracing uses the precomputed per-layer array)."""
+        if self.sliding_window is None:
+            return False
+        return (layer_idx + 1) % self.sliding_window_pattern != 0
+
+
+def tiny_config(
+    vocab_size: int = 384,
+    hidden_size: int = 64,
+    n_layers: int = 4,
+    n_heads: int = 4,
+    n_kv_heads: int = 2,
+    mlp_hidden: int = 128,
+    **kw: Any,
+) -> ModelConfig:
+    """2-layer/64-dim-class random-init config for CPU tests (SURVEY.md §4)."""
+    return ModelConfig(
+        vocab_size=vocab_size,
+        hidden_size=hidden_size,
+        n_layers=n_layers,
+        n_heads=n_heads,
+        n_kv_heads=n_kv_heads,
+        head_dim=hidden_size // n_heads,
+        mlp_hidden=mlp_hidden,
+        **kw,
+    )
+
+
+def config_from_hf(hf: Mapping[str, Any]) -> ModelConfig:
+    """Build a ModelConfig from an HF ``config.json`` dict.
+
+    Covers the families in the reference registry (model_utils.py:19-47):
+    llama, qwen2, qwen3(_moe), gemma2, gemma3 (text_config nested — the
+    reference special-cases this in _get_n_layers, model_utils.py:267-269).
+    """
+    model_type = hf.get("model_type", "llama")
+    if model_type == "gemma3" and "text_config" in hf:
+        inner = dict(hf["text_config"])
+        inner.setdefault("model_type", "gemma3_text")
+        return config_from_hf(inner)
+
+    hidden = hf["hidden_size"]
+    n_heads = hf["num_attention_heads"]
+    head_dim = hf.get("head_dim") or hidden // n_heads
+    rope_scaling = None
+    rs = hf.get("rope_scaling")
+    if rs:
+        rope_type = rs.get("rope_type", rs.get("type"))
+        if rope_type == "llama3":
+            rope_scaling = RopeScaling(
+                factor=rs["factor"],
+                kind="llama3",
+                low_freq_factor=rs["low_freq_factor"],
+                high_freq_factor=rs["high_freq_factor"],
+                original_max_position=rs["original_max_position_embeddings"],
+            )
+        elif rope_type in ("linear", "default", None):
+            if rs.get("factor", 1.0) != 1.0:
+                rope_scaling = RopeScaling(factor=rs["factor"], kind="linear")
+        else:
+            raise ValueError(f"unsupported rope_scaling type: {rope_type!r}")
+
+    common = dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hidden,
+        n_layers=hf["num_hidden_layers"],
+        n_heads=n_heads,
+        n_kv_heads=hf.get("num_key_value_heads", n_heads),
+        head_dim=head_dim,
+        mlp_hidden=hf["intermediate_size"],
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_eps=hf.get("rms_norm_eps", 1e-5),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        rope_scaling=rope_scaling,
+        max_position=hf.get("max_position_embeddings", 8192),
+    )
+
+    if model_type in ("llama", "mistral"):
+        return ModelConfig(**common)
+    if model_type == "qwen2":
+        return ModelConfig(**common, qkv_bias=True)
+    if model_type == "qwen3":
+        return ModelConfig(**common, use_qk_norm=True)
+    if model_type == "qwen3_moe":
+        return ModelConfig(
+            **common,
+            use_qk_norm=True,
+            n_experts=hf["num_experts"],
+            n_experts_per_tok=hf["num_experts_per_tok"],
+            moe_mlp_hidden=hf["moe_intermediate_size"],
+        )
+    if model_type == "gemma2":
+        return ModelConfig(
+            **common,
+            attn_logit_softcap=hf.get("attn_logit_softcapping", 50.0),
+            final_logit_softcap=hf.get("final_logit_softcapping", 30.0),
+            use_post_norms=True,
+            embed_scale=True,
+            norm_scale_plus_one=True,
+            query_scale=hf.get("query_pre_attn_scalar", 224) ** -0.5,
+            sliding_window=hf.get("sliding_window", 4096),
+            sliding_window_pattern=2,
+        )
+    if model_type in ("gemma3_text", "gemma3"):
+        return ModelConfig(
+            **common,
+            use_post_norms=True,
+            use_qk_norm=True,
+            embed_scale=True,
+            norm_scale_plus_one=True,
+            query_scale=hf.get("query_pre_attn_scalar", 256) ** -0.5,
+            sliding_window=hf.get("sliding_window", 1024),
+            sliding_window_pattern=hf.get("sliding_window_pattern", 6),
+            rope_theta_local=hf.get("rope_local_base_freq", 10000.0),
+        )
+    raise ValueError(f"unsupported model_type: {model_type!r}")

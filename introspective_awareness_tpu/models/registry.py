@@ -1,0 +1,63 @@
+"""Model registry: short name → HF repo, plus family metadata.
+
+Mirrors the reference registry (model_utils.py:19-53). ``PRE_QUANTIZED_MODELS``
+is kept for CLI compatibility; on TPU the loader just streams whatever dtype
+the checkpoint holds into bf16 (TPUs are bf16-first), so the flag only
+suppresses quantization warnings rather than switching loaders.
+"""
+
+from __future__ import annotations
+
+MODEL_NAME_MAP = {
+    # DeepSeek models
+    "deepseek_v3": "deepseek-ai/DeepSeek-V3",
+    "deepseek_v2.5": "deepseek-ai/DeepSeek-V2.5",
+    "deepseek_v2": "deepseek-ai/DeepSeek-V2",
+    # Llama models
+    "llama_405b": "meta-llama/Llama-3.1-405B-Instruct",
+    "llama_70b": "meta-llama/Llama-3.1-70B-Instruct",
+    "llama_8b": "meta-llama/Llama-3.1-8B-Instruct",
+    "llama_1b": "meta-llama/Llama-3.2-1B-Instruct",  # CPU/one-chip smoke config
+    # Qwen models
+    "qwen3_235b": "Qwen/Qwen3-235B-A22B-Instruct-2507",  # MoE: 235B total / 22B active
+    "qwen_72b": "Qwen/Qwen2.5-72B-Instruct",
+    "qwen_32b": "Qwen/Qwen2.5-32B-Instruct",
+    "qwen_14b": "Qwen/Qwen2.5-14B-Instruct",
+    "qwen_7b": "Qwen/Qwen2.5-7B-Instruct",
+    # Moonshot AI models
+    "kimi_k2": "moonshotai/Kimi-K2-Instruct-0905",
+    # Gemma models (Google)
+    "gemma2_2b": "google/gemma-2-2b-it",
+    "gemma2_9b": "google/gemma-2-9b-it",
+    "gemma2_27b": "google/gemma-2-27b-it",
+    "gemma3_27b": "google/gemma-3-27b-it",
+}
+
+PRE_QUANTIZED_MODELS = {
+    "kimi_k2",  # FP8
+    "deepseek_v3",  # FineGrainedFP8
+}
+
+# Chat templates for these models have no system role; system messages are
+# dropped before rendering (reference detect_injected_thoughts.py:81-99).
+MODELS_WITHOUT_SYSTEM_ROLE = [
+    "gemma_2b",
+    "gemma_7b",
+    "gemma2_2b",
+    "gemma2_9b",
+    "gemma2_27b",
+    "gemma3_27b",
+]
+
+
+def resolve_model_name(name: str) -> str:
+    """Short name → HF repo id (unknown names pass through, like the reference
+    ``MODEL_NAME_MAP.get(model_name, model_name)``, model_utils.py:82)."""
+    return MODEL_NAME_MAP.get(name, name)
+
+
+def get_layer_at_fraction(n_layers: int, fraction: float) -> int:
+    """Fraction through the model → clamped layer index
+    (reference model_utils.py:903-916)."""
+    layer_idx = int(n_layers * fraction)
+    return max(0, min(layer_idx, n_layers - 1))

@@ -1,0 +1,131 @@
+"""Host-side tokenization + chat templates.
+
+Two implementations behind one small interface:
+
+- ``HFTokenizer`` wraps an HF ``AutoTokenizer`` (the reference's path,
+  model_utils.py:91-101) for real checkpoints, with left padding and
+  pad-token fallback exactly as the reference sets them.
+- ``ByteTokenizer`` is a dependency-free byte-level tokenizer with a textual
+  chat template, used by CPU tests and the bench smoke model. Because the
+  template is plain text, the "Trial N" tokenize-prefix locator
+  (reference steering_utils.py:270-287) works identically on it.
+
+Tokenization never touches the device — chat templates render on host and only
+padded id arrays cross to TPU (SURVEY.md §2.2 "transformers" row).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+import numpy as np
+
+
+class Tokenizer(Protocol):
+    name: str
+    pad_id: int
+    eos_ids: tuple[int, ...]
+    vocab_size: int
+
+    def encode(self, text: str) -> list[int]: ...
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str: ...
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True
+    ) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0-255 are bytes; 256=pad, 257=bos, 258=eos."""
+
+    PAD, BOS, EOS = 256, 257, 258
+
+    def __init__(self, add_bos: bool = True):
+        self.name = "byte"
+        self.pad_id = self.PAD
+        self.bos_id = self.BOS
+        self.eos_ids = (self.EOS,)
+        self.vocab_size = 259
+        self.add_bos = add_bos
+
+    def encode(self, text: str) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.BOS] + ids) if self.add_bos else ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        raw = bytes(int(i) for i in ids if int(i) < 256)
+        return raw.decode("utf-8", errors="replace")
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True
+    ) -> str:
+        parts = []
+        for m in messages:
+            parts.append(f"<|{m['role']}|>\n{m['content']}<|end|>\n")
+        if add_generation_prompt:
+            parts.append("<|assistant|>\n")
+        return "".join(parts)
+
+
+class HFTokenizer:
+    """Thin wrapper over transformers.AutoTokenizer (left padding, pad fallback)."""
+
+    def __init__(self, path: str, trust_remote_code: bool = True):
+        from transformers import AutoTokenizer
+
+        self.name = path
+        self._tok = AutoTokenizer.from_pretrained(path, trust_remote_code=trust_remote_code)
+        self._tok.padding_side = "left"
+        if self._tok.pad_token is None:
+            self._tok.pad_token = self._tok.eos_token  # reference model_utils.py:100-101
+        self.pad_id = self._tok.pad_token_id
+        eos = {self._tok.eos_token_id}
+        # Llama-3 chat turns end with <|eot_id|>, not the base eos. Guard
+        # against convert_tokens_to_ids returning unk_token_id for absent
+        # tokens (it does on tokenizers that define an unk token).
+        vocab = self._tok.get_vocab()
+        for tok_str in ("<|eot_id|>", "<|im_end|>", "<end_of_turn>"):
+            tid = vocab.get(tok_str)
+            if tid is not None and tid >= 0:
+                eos.add(tid)
+        self.eos_ids = tuple(sorted(t for t in eos if t is not None))
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok(text, add_special_tokens=True)["input_ids"]
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True
+    ) -> str:
+        return self._tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=add_generation_prompt
+        )
+
+
+def pad_batch(
+    id_lists: list[list[int]],
+    pad_id: int,
+    pad_to_multiple: int = 64,
+    min_len: int | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Left-pad a ragged batch → (ids [B, S], mask [B, S]).
+
+    Left padding matches the reference's decoder-only batching
+    (model_utils.py:96-97); padding S up to a multiple keeps the jitted
+    prefill shape-stable across sweep batches (SURVEY.md §7.4.2).
+    """
+    longest = max(len(x) for x in id_lists)
+    if min_len is not None:
+        longest = max(longest, min_len)
+    S = ((longest + pad_to_multiple - 1) // pad_to_multiple) * pad_to_multiple
+    B = len(id_lists)
+    ids = np.full((B, S), pad_id, np.int32)
+    mask = np.zeros((B, S), np.int32)
+    for i, row in enumerate(id_lists):
+        ids[i, S - len(row):] = row
+        mask[i, S - len(row):] = 1
+    return ids, mask

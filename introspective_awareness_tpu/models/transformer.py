@@ -1,0 +1,488 @@
+"""First-party JAX decoder with the *traced* intervened forward pass.
+
+This replaces the reference's PyTorch forward hooks (capture:
+model_utils.py:293-345, inject: model_utils.py:347-453 and :687-879) with XLA
+operations inside a ``lax.scan`` over stacked layer parameters:
+
+- **Injection** is a masked add at every layer, gated by
+  ``layer_ids == steer.layer_idx`` — the layer index and strength are *runtime
+  operands*, so one compiled executable serves the whole layer x strength sweep
+  with zero recompiles (SURVEY.md §7.1).
+- **Position gating** is a traced ``[B, S]`` mask computed from per-example
+  steering start positions with the same left-pad arithmetic as the reference
+  (model_utils.py:819-825), but vectorized — no Python loop over the batch
+  (the reference's hot-loop hook, model_utils.py:774-791).
+- **Capture** is the dual: the scan stacks each layer's output residual at a
+  per-example token index as a scan output → ``[L, B, H]``, so extraction for
+  *all* layers costs one forward (the reference re-runs the model once per
+  layer, detect_injected_thoughts.py:1551-1561).
+
+One module covers Llama 3.x / Qwen2.5 / Qwen3(+MoE) / Gemma-2/3 via config
+flags — the architecture quirks the reference monkey-patches into HF
+(model_utils.py:144-248) are first-party code paths here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from introspective_awareness_tpu.models.config import ModelConfig
+from introspective_awareness_tpu.parallel import sharding as shax
+
+# Big negative for masked attention logits (avoid -inf NaN propagation in bf16).
+_NEG_INF = -1e9
+
+
+class SteerSpec(NamedTuple):
+    """Runtime steering operands (all traced — changing them never recompiles).
+
+    Semantics mirror generate_batch_with_multi_steering
+    (reference model_utils.py:687-879): per-example vectors, per-example start
+    positions (already left-pad adjusted into padded coordinates), one target
+    layer, one scalar strength.
+    """
+
+    layer_idx: jax.Array  # int32 scalar: which layer's output residual to steer
+    strength: jax.Array  # f32 scalar multiplier
+    vectors: jax.Array  # [B, H] per-example steering vectors (un-scaled)
+    pos_mask: jax.Array  # [B, S] float 0/1: positions (padded coords) to steer
+
+
+def no_steer(batch: int, seq: int, hidden: int, dtype=jnp.float32) -> SteerSpec:
+    """A SteerSpec that is an exact no-op (strength 0)."""
+    return SteerSpec(
+        layer_idx=jnp.int32(0),
+        strength=jnp.float32(0.0),
+        vectors=jnp.zeros((batch, hidden), dtype),
+        pos_mask=jnp.zeros((batch, seq), dtype),
+    )
+
+
+class KVCache(NamedTuple):
+    """Left-pad-aware batched KV cache.
+
+    Slots are written densely in slot order ([0, S) at prefill, then one per
+    decode step); validity lives in ``slot_mask`` and RoPE/window positions in
+    ``positions``, so left-padded prompts need no re-packing.
+    """
+
+    k: jax.Array  # [L, B, T, KVH, D]
+    v: jax.Array  # [L, B, T, KVH, D]
+    slot_mask: jax.Array  # [B, T] bool — valid kv slots
+    positions: jax.Array  # [B, T] int32 — rope position of each slot
+    length: jax.Array  # int32 scalar — next write slot
+
+
+def init_cache(
+    cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.float32
+) -> KVCache:
+    return KVCache(
+        k=jnp.zeros((cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim), dtype),
+        v=jnp.zeros((cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim), dtype),
+        slot_mask=jnp.zeros((batch, max_len), jnp.bool_),
+        positions=jnp.zeros((batch, max_len), jnp.int32),
+        length=jnp.int32(0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Parameter init / logical sharding axes
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32) -> dict:
+    """Random-init parameter pytree with stacked layers (leading dim L)."""
+    keys = iter(jax.random.split(key, 32))
+    H, L = cfg.hidden_size, cfg.n_layers
+    QD, KVD, M, V = cfg.q_dim, cfg.kv_dim, cfg.mlp_hidden, cfg.vocab_size
+
+    def w(k, *shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] if len(shape) > 1 else H) ** -0.5
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    norm_init = jnp.zeros if cfg.norm_scale_plus_one else jnp.ones
+    layers: dict[str, Any] = {
+        "attn_norm": norm_init((L, H), dtype),
+        "wq": w(next(keys), L, H, QD),
+        "wk": w(next(keys), L, H, KVD),
+        "wv": w(next(keys), L, H, KVD),
+        "wo": w(next(keys), L, QD, H),
+        "mlp_norm": norm_init((L, H), dtype),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, QD), dtype)
+        layers["bk"] = jnp.zeros((L, KVD), dtype)
+        layers["bv"] = jnp.zeros((L, KVD), dtype)
+    if cfg.use_qk_norm:
+        layers["q_norm"] = norm_init((L, cfg.head_dim), dtype)
+        layers["k_norm"] = norm_init((L, cfg.head_dim), dtype)
+    if cfg.use_post_norms:
+        layers["post_attn_norm"] = norm_init((L, H), dtype)
+        layers["post_mlp_norm"] = norm_init((L, H), dtype)
+    if cfg.is_moe:
+        E, ME = cfg.n_experts, cfg.moe_mlp_hidden
+        layers["router"] = w(next(keys), L, H, E)
+        layers["w_gate"] = w(next(keys), L, E, H, ME)
+        layers["w_up"] = w(next(keys), L, E, H, ME)
+        layers["w_down"] = w(next(keys), L, E, ME, H)
+    else:
+        layers["w_gate"] = w(next(keys), L, H, M)
+        layers["w_up"] = w(next(keys), L, H, M)
+        layers["w_down"] = w(next(keys), L, M, H)
+
+    params = {
+        "embed": w(next(keys), V, H, scale=1.0),
+        "layers": layers,
+        "final_norm": norm_init((H,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(next(keys), H, V)
+    return params
+
+
+def param_logical_axes(cfg: ModelConfig) -> dict:
+    """Logical-axis pytree mirroring ``init_params`` (feeds ShardingRules)."""
+    LA, E, H, M, V = shax.LAYERS, shax.EXPERT, shax.EMBED, shax.MLP, shax.VOCAB
+    HEADS, KVH = shax.HEADS, shax.KV_HEADS
+    layers: dict[str, Any] = {
+        "attn_norm": (LA, H),
+        # q/k/v/o: shard the head (output) dim over 'model'
+        "wq": (LA, H, HEADS),
+        "wk": (LA, H, KVH),
+        "wv": (LA, H, KVH),
+        "wo": (LA, HEADS, H),
+        "mlp_norm": (LA, H),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = (LA, HEADS)
+        layers["bk"] = (LA, KVH)
+        layers["bv"] = (LA, KVH)
+    if cfg.use_qk_norm:
+        layers["q_norm"] = (LA, None)
+        layers["k_norm"] = (LA, None)
+    if cfg.use_post_norms:
+        layers["post_attn_norm"] = (LA, H)
+        layers["post_mlp_norm"] = (LA, H)
+    if cfg.is_moe:
+        layers["router"] = (LA, H, None)
+        layers["w_gate"] = (LA, E, H, M)
+        layers["w_up"] = (LA, E, H, M)
+        layers["w_down"] = (LA, E, M, H)
+    else:
+        layers["w_gate"] = (LA, H, M)
+        layers["w_up"] = (LA, H, M)
+        layers["w_down"] = (LA, M, H)
+    axes = {
+        "embed": (V, H),
+        "layers": layers,
+        "final_norm": (H,),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = (H, V)
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float, plus_one: bool) -> jax.Array:
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    xf = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    scale = (1.0 + w.astype(jnp.float32)) if plus_one else w.astype(jnp.float32)
+    return (xf * scale).astype(dt)
+
+
+def rope_inv_freq(cfg: ModelConfig, local: bool = False) -> jax.Array:
+    theta = cfg.rope_theta_local if (local and cfg.rope_theta_local) else cfg.rope_theta
+    d = cfg.head_dim
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    rs = cfg.rope_scaling
+    if rs is not None and not local and rs.kind == "linear":
+        # Gemma-3-style linear scaling on global layers.
+        inv = inv / rs.factor
+    elif rs is not None and not local:
+        # Llama-3 frequency-dependent scaling (matches HF rope_type="llama3").
+        low_wl = rs.original_max_position / rs.low_freq_factor
+        high_wl = rs.original_max_position / rs.high_freq_factor
+        wl = 2.0 * jnp.pi / inv
+        smooth = (rs.original_max_position / wl - rs.low_freq_factor) / (
+            rs.high_freq_factor - rs.low_freq_factor
+        )
+        scaled = jnp.where(
+            wl > low_wl,
+            inv / rs.factor,
+            jnp.where(wl < high_wl, inv, (1 - smooth) * inv / rs.factor + smooth * inv),
+        )
+        inv = scaled
+    return inv
+
+
+def rope_cos_sin(positions: jax.Array, inv_freq: jax.Array):
+    """positions [B, S] → cos/sin [B, S, D] (HF half-rotation convention)."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
+    angles = jnp.concatenate([angles, angles], axis=-1)  # [B, S, D]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x [B, S, NH, D]; cos/sin [B, S, D]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (
+        x.astype(jnp.float32) * cos[:, :, None, :] + rotated.astype(jnp.float32) * sin[:, :, None, :]
+    ).astype(x.dtype)
+
+
+def _attention(
+    q: jax.Array,  # [B, S, NH, D]
+    k: jax.Array,  # [B, T, KVH, D]
+    v: jax.Array,  # [B, T, KVH, D]
+    allowed: jax.Array,  # [B, S, T] bool
+    cfg: ModelConfig,
+) -> jax.Array:
+    B, S, NH, D = q.shape
+    KVH = k.shape[2]
+    groups = NH // KVH
+    qg = q.reshape(B, S, KVH, groups, D)
+    scale = cfg.query_scale if cfg.query_scale is not None else D**-0.5
+    scores = jnp.einsum(
+        "bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    if cfg.attn_logit_softcap:
+        cap = cfg.attn_logit_softcap
+        scores = cap * jnp.tanh(scores / cap)
+    scores = jnp.where(allowed[:, None, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(B, S, NH, D)
+
+
+# ---------------------------------------------------------------------------
+# The forward pass (full / prefill / decode unified)
+# ---------------------------------------------------------------------------
+
+
+class ForwardResult(NamedTuple):
+    logits: jax.Array | None  # [B, V] (last position) or [B, S, V] or None
+    cache: KVCache | None
+    captured: jax.Array | None  # [L, B, H] layer-output residuals at capture_pos
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "use_cache", "capture", "logits_mode"),
+    # The KV cache is consumed and replaced every step; donation lets XLA
+    # update it in place instead of holding two full [L,B,T,KVH,D] copies.
+    donate_argnames=("cache",),
+)
+def forward(
+    params: dict,
+    cfg: ModelConfig,
+    ids: jax.Array,  # [B, S]
+    attn_mask: jax.Array,  # [B, S] 1 = real token (left padding)
+    positions: jax.Array,  # [B, S] rope positions
+    cache: KVCache | None = None,
+    steer: SteerSpec | None = None,
+    capture_pos: jax.Array | None = None,  # [B] padded token index to capture
+    *,
+    use_cache: bool = False,
+    capture: bool = False,
+    logits_mode: str = "last",  # "last" | "all" | "none"
+) -> ForwardResult:
+    """One traced forward covering extraction, prefill, and decode.
+
+    - ``use_cache=False``: attention over the current chunk only (the
+      extraction path; reference runs this with use_cache=False too,
+      model_utils.py:338).
+    - ``use_cache=True`` with ``cache.length == 0``: prefill (writes slots).
+    - ``use_cache=True`` with S == 1: one decode step.
+    """
+    B, S = ids.shape
+    dtype = params["embed"].dtype
+
+    h = params["embed"][ids]
+    if cfg.embed_scale:
+        h = (h.astype(jnp.float32) * (cfg.hidden_size**0.5)).astype(dtype)
+
+    # Rope tables (global + optional local-theta variant for Gemma-3).
+    cos_g, sin_g = rope_cos_sin(positions, rope_inv_freq(cfg, local=False))
+    if cfg.rope_theta_local:
+        cos_l, sin_l = rope_cos_sin(positions, rope_inv_freq(cfg, local=True))
+    else:
+        cos_l, sin_l = cos_g, sin_g
+
+    # --- attention visibility -------------------------------------------------
+    if use_cache:
+        assert cache is not None
+        T = cache.k.shape[2]
+        length = cache.length
+        new_slot_mask = lax.dynamic_update_slice(
+            cache.slot_mask, attn_mask.astype(jnp.bool_), (0, length)
+        )
+        new_positions = lax.dynamic_update_slice(cache.positions, positions, (0, length))
+        q_slots = length + jnp.arange(S)  # [S]
+        causal = jnp.arange(T)[None, :] <= q_slots[:, None]  # [S, T]
+        allowed = causal[None, :, :] & new_slot_mask[:, None, :]  # [B, S, T]
+        k_positions = new_positions
+    else:
+        T = S
+        causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        allowed = causal[None, :, :] & attn_mask[:, None, :].astype(jnp.bool_)
+        k_positions = positions
+        new_slot_mask = new_positions = None
+        length = None
+
+    if cfg.sliding_window is not None:
+        delta = positions[:, :, None] - k_positions[:, None, :]  # [B, S, T]
+        allowed_local = allowed & (delta < cfg.sliding_window) & (delta >= 0)
+    else:
+        allowed_local = allowed
+
+    # Per-layer flags/ids as scan xs (runtime operands, never recompile).
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    is_sliding = jnp.array(
+        [cfg.layer_is_sliding(i) for i in range(cfg.n_layers)], jnp.bool_
+    )
+
+    if steer is None:
+        steer = no_steer(B, S, cfg.hidden_size, jnp.float32)
+    steer_add = (
+        steer.strength
+        * steer.vectors[:, None, :].astype(jnp.float32)
+        * steer.pos_mask[:, :, None].astype(jnp.float32)
+    )  # [B, S, H]
+
+    if capture_pos is None:
+        capture_pos = jnp.full((B,), S - 1, jnp.int32)
+    batch_ix = jnp.arange(B)
+
+    plus1 = cfg.norm_scale_plus_one
+
+    def block(h, xs):
+        lp, layer_id, sliding = xs["p"], xs["layer_id"], xs["sliding"]
+
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_eps, plus1)
+        q = jnp.einsum("bsh,hq->bsq", x, lp["wq"])
+        k = jnp.einsum("bsh,hk->bsk", x, lp["wk"])
+        v = jnp.einsum("bsh,hk->bsk", x, lp["wv"])
+        if cfg.qkv_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        if cfg.use_qk_norm:
+            q = rms_norm(q, lp["q_norm"], cfg.rms_eps, plus1)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_eps, plus1)
+
+        cos = jnp.where(sliding, cos_l, cos_g) if cfg.rope_theta_local else cos_g
+        sin = jnp.where(sliding, sin_l, sin_g) if cfg.rope_theta_local else sin_g
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        if use_cache:
+            k_full = lax.dynamic_update_slice(xs["ck"], k, (0, length, 0, 0))
+            v_full = lax.dynamic_update_slice(xs["cv"], v, (0, length, 0, 0))
+        else:
+            k_full, v_full = k, v
+
+        amask = jnp.where(sliding, allowed_local, allowed) if cfg.sliding_window else allowed
+        attn = _attention(q, k_full, v_full, amask, cfg)
+        attn = jnp.einsum("bsq,qh->bsh", attn.reshape(B, S, cfg.q_dim), lp["wo"])
+        if cfg.use_post_norms:
+            attn = rms_norm(attn, lp["post_attn_norm"], cfg.rms_eps, plus1)
+        h = h + attn
+
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, plus1)
+        if cfg.is_moe:
+            mlp = _moe_mlp(x, lp, cfg)
+        else:
+            gate = jnp.einsum("bsh,hm->bsm", x, lp["w_gate"])
+            up = jnp.einsum("bsh,hm->bsm", x, lp["w_up"])
+            mlp = jnp.einsum("bsm,mh->bsh", jax.nn.silu(gate) * up, lp["w_down"])
+        if cfg.use_post_norms:
+            mlp = rms_norm(mlp, lp["post_mlp_norm"], cfg.rms_eps, plus1)
+        h = h + mlp
+
+        # --- traced steering injection (the hook replacement) ----------------
+        gain = (layer_id == steer.layer_idx).astype(jnp.float32)
+        h = (h.astype(jnp.float32) + gain * steer_add).astype(h.dtype)
+
+        ys = {}
+        if use_cache:
+            ys["ck"], ys["cv"] = k_full, v_full
+        if capture:
+            ys["cap"] = h[batch_ix, capture_pos, :]  # [B, H]
+        return h, ys
+
+    xs = {"p": params["layers"], "layer_id": layer_ids, "sliding": is_sliding}
+    if use_cache:
+        xs["ck"], xs["cv"] = cache.k, cache.v
+
+    h, ys = lax.scan(block, h, xs)
+
+    new_cache = None
+    if use_cache:
+        new_cache = KVCache(
+            k=ys["ck"],
+            v=ys["cv"],
+            slot_mask=new_slot_mask,
+            positions=new_positions,
+            length=length + S,
+        )
+    captured = ys.get("cap") if capture else None  # [L, B, H]
+
+    logits = None
+    if logits_mode != "none":
+        hn = h if logits_mode == "all" else h[:, -1:, :]
+        hn = rms_norm(hn, params["final_norm"], cfg.rms_eps, plus1)
+        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+        logits = jnp.einsum(
+            "bsh,hv->bsv", hn, head, preferred_element_type=jnp.float32
+        )
+        if cfg.final_logit_softcap:
+            cap = cfg.final_logit_softcap
+            logits = cap * jnp.tanh(logits / cap)
+        if logits_mode == "last":
+            logits = logits[:, 0, :]  # hn was already sliced to the last position
+    return ForwardResult(logits=logits, cache=new_cache, captured=captured)
+
+
+def _moe_mlp(x: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
+    """Top-k MoE MLP, dense-combine formulation.
+
+    Every expert runs over every token and the top-k router weights select via
+    a combine matrix. With the expert dim sharded over the mesh ``expert``
+    axis, each device computes only its resident experts (EP with replicated
+    tokens) — the right baseline for eval batch sizes; a capacity-based
+    dispatch kernel is the later optimization. Per-expert residual injection
+    (BASELINE.json config #5) composes with this because steering happens on
+    the combined residual stream.
+    """
+    logits = jnp.einsum("bsh,he->bse", x, lp["router"], preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = lax.top_k(probs, cfg.n_experts_per_tok)  # [B,S,K]
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    combine = jnp.sum(
+        jax.nn.one_hot(topi, cfg.n_experts, dtype=x.dtype) * topv[..., None].astype(x.dtype),
+        axis=2,
+    )  # [B, S, E]
+    gate = jnp.einsum("bsh,ehm->ebsm", x, lp["w_gate"])
+    up = jnp.einsum("bsh,ehm->ebsm", x, lp["w_up"])
+    act = jax.nn.silu(gate) * up
+    eo = jnp.einsum("ebsm,emh->ebsh", act, lp["w_down"])
+    return jnp.einsum("ebsh,bse->bsh", eo, combine)
+
+
+def make_positions(attn_mask: jax.Array) -> jax.Array:
+    """Left-pad-aware rope positions: real tokens get 0..len-1, pads get 0."""
+    return jnp.maximum(jnp.cumsum(attn_mask.astype(jnp.int32), axis=1) - 1, 0)
