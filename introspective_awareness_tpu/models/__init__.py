@@ -16,6 +16,8 @@ from introspective_awareness_tpu.models.registry import (
     MODEL_NAME_MAP,
     MODELS_WITHOUT_SYSTEM_ROLE,
     PRE_QUANTIZED_MODELS,
+    UNSUPPORTED_MODELS,
+    check_supported,
     get_layer_at_fraction,
     resolve_model_name,
 )
@@ -45,6 +47,8 @@ __all__ = [
     "MODEL_NAME_MAP",
     "MODELS_WITHOUT_SYSTEM_ROLE",
     "PRE_QUANTIZED_MODELS",
+    "UNSUPPORTED_MODELS",
+    "check_supported",
     "get_layer_at_fraction",
     "resolve_model_name",
     "ByteTokenizer",

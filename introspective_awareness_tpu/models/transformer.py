@@ -24,7 +24,6 @@ flags — the architecture quirks the reference monkey-patches into HF
 
 from __future__ import annotations
 
-import dataclasses
 from functools import partial
 from typing import Any, NamedTuple
 
@@ -280,7 +279,7 @@ class ForwardResult(NamedTuple):
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "use_cache", "capture", "logits_mode"),
+    static_argnames=("cfg", "use_cache", "capture", "logits_mode", "is_prefill"),
     # The KV cache is consumed and replaced every step; donation lets XLA
     # update it in place instead of holding two full [L,B,T,KVH,D] copies.
     donate_argnames=("cache",),
@@ -298,14 +297,18 @@ def forward(
     use_cache: bool = False,
     capture: bool = False,
     logits_mode: str = "last",  # "last" | "all" | "none"
+    is_prefill: bool = False,
 ) -> ForwardResult:
     """One traced forward covering extraction, prefill, and decode.
 
     - ``use_cache=False``: attention over the current chunk only (the
       extraction path; reference runs this with use_cache=False too,
       model_utils.py:338).
-    - ``use_cache=True`` with ``cache.length == 0``: prefill (writes slots).
-    - ``use_cache=True`` with S == 1: one decode step.
+    - ``use_cache=True, is_prefill=True``: prefill into an empty cache —
+      attention runs over just the S-token chunk (not the full T-slot buffer,
+      which would inflate prefill FLOPs by T/S) while k/v are written into the
+      full-length cache.
+    - ``use_cache=True`` with S == 1: one decode step over the cache.
     """
     B, S = ids.shape
     dtype = params["embed"].dtype
@@ -324,18 +327,24 @@ def forward(
     # --- attention visibility -------------------------------------------------
     if use_cache:
         assert cache is not None
-        T = cache.k.shape[2]
         length = cache.length
         new_slot_mask = lax.dynamic_update_slice(
             cache.slot_mask, attn_mask.astype(jnp.bool_), (0, length)
         )
         new_positions = lax.dynamic_update_slice(cache.positions, positions, (0, length))
-        q_slots = length + jnp.arange(S)  # [S]
-        causal = jnp.arange(T)[None, :] <= q_slots[:, None]  # [S, T]
-        allowed = causal[None, :, :] & new_slot_mask[:, None, :]  # [B, S, T]
-        k_positions = new_positions
+        if is_prefill:
+            # Empty cache: attend over just the current chunk; k/v still land
+            # in the full-length buffers below.
+            causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+            allowed = causal[None, :, :] & attn_mask[:, None, :].astype(jnp.bool_)
+            k_positions = positions
+        else:
+            T = cache.k.shape[2]
+            q_slots = length + jnp.arange(S)  # [S]
+            causal = jnp.arange(T)[None, :] <= q_slots[:, None]  # [S, T]
+            allowed = causal[None, :, :] & new_slot_mask[:, None, :]  # [B, S, T]
+            k_positions = new_positions
     else:
-        T = S
         causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
         allowed = causal[None, :, :] & attn_mask[:, None, :].astype(jnp.bool_)
         k_positions = positions
@@ -392,11 +401,14 @@ def forward(
         if use_cache:
             k_full = lax.dynamic_update_slice(xs["ck"], k, (0, length, 0, 0))
             v_full = lax.dynamic_update_slice(xs["cv"], v, (0, length, 0, 0))
+            # Prefill attends over the chunk only; decode over the full cache.
+            k_att, v_att = (k, v) if is_prefill else (k_full, v_full)
         else:
             k_full, v_full = k, v
+            k_att, v_att = k, v
 
         amask = jnp.where(sliding, allowed_local, allowed) if cfg.sliding_window else allowed
-        attn = _attention(q, k_full, v_full, amask, cfg)
+        attn = _attention(q, k_att, v_att, amask, cfg)
         attn = jnp.einsum("bsq,qh->bsh", attn.reshape(B, S, cfg.q_dim), lp["wo"])
         if cfg.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], cfg.rms_eps, plus1)

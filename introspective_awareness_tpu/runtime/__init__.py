@@ -1,0 +1,10 @@
+"""runtime/ — intervened forward, KV-cache decode, sampling, ModelRunner.
+
+The L1 runtime of the framework (reference model_utils.py ModelWrapper), built
+on the traced capture/steer forward in ``models.transformer``.
+"""
+
+from introspective_awareness_tpu.runtime.generate import GenSpec, generate_tokens
+from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+__all__ = ["GenSpec", "generate_tokens", "ModelRunner"]

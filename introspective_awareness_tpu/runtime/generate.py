@@ -1,0 +1,115 @@
+"""Batched steered generation: one jitted prefill + ``lax.scan`` decode.
+
+Replaces the reference's ``model.generate`` + Python steering hook hot loop
+(model_utils.py:750-866: a Python for-loop over the batch inside a hook fired
+per generated token per layer). Here the whole decode is one compiled program;
+steering semantics match the reference exactly:
+
+- prompt phase: steer padded positions >= per-example start
+  (model_utils.py:781-791 with the left-pad adjustment at :819-825)
+- decode phase: steer every generated token unconditionally
+  (model_utils.py:774-777)
+
+Layer index, strength, vectors, start positions, temperature, and the RNG key
+are all runtime operands — the entire model x layer x strength x concept sweep
+reuses a single executable per (batch, seq, max_tokens) shape bucket.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from introspective_awareness_tpu.models.config import ModelConfig
+from introspective_awareness_tpu.models.transformer import (
+    SteerSpec,
+    forward,
+    init_cache,
+    make_positions,
+)
+
+
+class GenSpec(NamedTuple):
+    """Traced sampling/steering operands for one generate call."""
+
+    rng: jax.Array  # PRNG key
+    temperature: jax.Array  # f32 scalar; <= 0 → greedy
+    steer_layer: jax.Array  # int32 scalar
+    steer_strength: jax.Array  # f32 scalar; 0 disables steering exactly
+    steer_vectors: jax.Array  # [B, H]
+    steer_start: jax.Array  # [B] int32, PADDED coords; 0 = steer whole prompt
+    eos_ids: jax.Array  # [n_eos] int32
+    pad_id: jax.Array  # int32 scalar
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+def generate_tokens(
+    params: dict,
+    cfg: ModelConfig,
+    ids: jax.Array,  # [B, S] left-padded
+    mask: jax.Array,  # [B, S]
+    spec: GenSpec,
+    *,
+    max_new_tokens: int,
+) -> jax.Array:
+    """Returns generated token ids ``[B, max_new_tokens]`` (pad after EOS)."""
+    B, S = ids.shape
+    positions = make_positions(mask)
+    true_len = mask.sum(axis=1).astype(jnp.int32)
+    dtype = params["embed"].dtype
+
+    prompt_pos_mask = (
+        (jnp.arange(S)[None, :] >= spec.steer_start[:, None]) & (mask > 0)
+    ).astype(jnp.float32)
+    steer_prompt = SteerSpec(
+        spec.steer_layer, spec.steer_strength, spec.steer_vectors, prompt_pos_mask
+    )
+    steer_decode = SteerSpec(
+        spec.steer_layer,
+        spec.steer_strength,
+        spec.steer_vectors,
+        jnp.ones((B, 1), jnp.float32),
+    )
+
+    cache = init_cache(cfg, B, S + max_new_tokens, dtype)
+    r = forward(
+        params, cfg, ids, mask, positions,
+        cache=cache, steer=steer_prompt, use_cache=True, logits_mode="last",
+        is_prefill=True,
+    )
+
+    def sample(logits, key):
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        temp = jnp.maximum(spec.temperature, 1e-6)
+        sampled = jax.random.categorical(key, logits / temp, axis=-1).astype(jnp.int32)
+        return jnp.where(spec.temperature > 0, sampled, greedy)
+
+    key, sub = jax.random.split(spec.rng)
+    tok0 = sample(r.logits, sub)
+    done0 = jnp.isin(tok0, spec.eos_ids)
+
+    def step(carry, t):
+        cache, prev, done, key = carry
+        key, sub = jax.random.split(key)
+        step_pos = (true_len + t - 1)[:, None]
+        out = forward(
+            params, cfg, prev[:, None], jnp.ones((B, 1), jnp.int32), step_pos,
+            cache=cache, steer=steer_decode, use_cache=True, logits_mode="last",
+        )
+        nxt = sample(out.logits, sub)
+        nxt = jnp.where(done, spec.pad_id, nxt)
+        done = done | jnp.isin(nxt, spec.eos_ids)
+        return (out.cache, nxt, done, key), nxt
+
+    if max_new_tokens > 1:
+        (_, _, _, _), rest = lax.scan(
+            step, (r.cache, tok0, done0, key), jnp.arange(1, max_new_tokens)
+        )
+        tokens = jnp.concatenate([tok0[:, None], rest.T], axis=1)
+    else:
+        tokens = tok0[:, None]
+    return tokens

@@ -1,0 +1,336 @@
+"""ModelRunner — the L1 runtime surface (reference ``ModelWrapper``,
+model_utils.py:56-900), re-designed for XLA.
+
+Same public surface: ``extract_activations``, ``generate``, ``generate_batch``,
+``generate_with_steering``, ``generate_batch_with_steering``,
+``generate_batch_with_multi_steering``, ``cleanup`` — but every method lowers
+to the same two compiled programs (capture forward / generate loop). There are
+no hooks to install or remove; "steering off" is strength 0 on the same
+executable, so control trials and injection trials share compilation.
+
+Batching policy: prompts are left-padded to a multiple of ``seq_multiple`` and
+the batch is padded to a multiple of ``batch_multiple`` so the sweep reuses a
+handful of executables regardless of ragged trial counts (SURVEY.md §7.4.2).
+"""
+
+from __future__ import annotations
+
+import gc
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from introspective_awareness_tpu.models.config import ModelConfig
+from introspective_awareness_tpu.models.registry import get_layer_at_fraction
+from introspective_awareness_tpu.models.tokenizer import Tokenizer, pad_batch
+from introspective_awareness_tpu.models.transformer import forward, make_positions
+from introspective_awareness_tpu.parallel import ShardingRules
+from introspective_awareness_tpu.parallel import sharding as shax
+from introspective_awareness_tpu.runtime.generate import GenSpec, generate_tokens
+
+
+class ModelRunner:
+    """Holds (possibly sharded) params + tokenizer and runs the eval workloads."""
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: ModelConfig,
+        tokenizer: Tokenizer,
+        model_name: str = "",
+        mesh=None,
+        rules: ShardingRules | None = None,
+        seq_multiple: int = 64,
+        batch_multiple: int = 8,
+        extract_chunk: int = 128,
+        seed: int = 0,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.mesh = mesh
+        self.rules = rules or ShardingRules()
+        self.seq_multiple = seq_multiple
+        self.batch_multiple = batch_multiple
+        self.extract_chunk = extract_chunk
+        self._seed = seed
+        self._calls = 0
+        self.n_layers = cfg.n_layers
+        self.hf_path = model_name
+
+    # -- helpers ------------------------------------------------------------
+
+    def _next_key(self, seed: Optional[int] = None) -> jax.Array:
+        if seed is None:
+            self._calls += 1
+            seed = self._seed * 1_000_003 + self._calls
+        return jax.random.key(seed)
+
+    def _shard_batch(self, arr: jax.Array) -> jax.Array:
+        if self.mesh is None:
+            return arr
+        spec = (shax.BATCH,) + (None,) * (arr.ndim - 1)
+        return jax.device_put(
+            arr, shax.logical_to_sharding(spec, self.mesh, self.rules)
+        )
+
+    def _prep(self, prompts: Sequence[str], min_len: int = 1):
+        rows = [self.tokenizer.encode(p) for p in prompts]
+        lens = np.array([len(r) for r in rows], np.int32)
+        B = len(rows)
+        pad_b = (-B) % self.batch_multiple
+        rows = rows + [rows[-1]] * pad_b  # repeat last row as batch filler
+        ids, mask = pad_batch(
+            rows, self.tokenizer.pad_id, self.seq_multiple, min_len=min_len
+        )
+        return (
+            self._shard_batch(jnp.asarray(ids)),
+            self._shard_batch(jnp.asarray(mask)),
+            lens,
+            B,
+        )
+
+    def _decode_row(self, row: np.ndarray) -> str:
+        out = []
+        eos = set(int(e) for e in self.tokenizer.eos_ids)
+        pad = int(self.tokenizer.pad_id)
+        for t in row.tolist():
+            if t in eos or t == pad:
+                break
+            out.append(t)
+        return self.tokenizer.decode(out, skip_special_tokens=True).strip()
+
+    # -- activation capture (reference model_utils.py:293-345) --------------
+
+    def extract_activations_all_layers(
+        self, prompts: Sequence[str], token_idx: int = -1
+    ) -> np.ndarray:
+        """One forward per chunk returns residuals for EVERY layer:
+        ``[n_layers, B, H]`` f32. The reference re-runs the model once per
+        layer (detect_injected_thoughts.py:1551-1561); here the layer sweep's
+        extraction cost is a single pass."""
+        if not prompts:
+            return np.zeros((self.cfg.n_layers, 0, self.cfg.hidden_size), np.float32)
+        outs = []
+        for i in range(0, len(prompts), self.extract_chunk):
+            chunk = list(prompts[i : i + self.extract_chunk])
+            ids, mask, lens, B = self._prep(chunk)
+            S = ids.shape[1]
+            # token_idx indexes the *unpadded* prompt; out-of-range would be
+            # silently clamped by XLA's gather, so validate on host.
+            if (token_idx >= 0 and (token_idx >= lens).any()) or (
+                token_idx < 0 and (-token_idx > lens).any()
+            ):
+                raise ValueError(
+                    f"token_idx {token_idx} out of range for prompt lengths "
+                    f"{lens.tolist()}"
+                )
+            if token_idx < 0:
+                cap = np.full((ids.shape[0],), S + token_idx, np.int32)
+            else:
+                pad_amounts = S - lens
+                cap = np.concatenate(
+                    [pad_amounts + token_idx, np.full((ids.shape[0] - B,), S - 1)]
+                ).astype(np.int32)
+            r = forward(
+                self.params, self.cfg, ids, mask, make_positions(mask),
+                capture_pos=jnp.asarray(cap), capture=True, logits_mode="none",
+            )
+            outs.append(np.asarray(r.captured, np.float32)[:, :B, :])
+        return np.concatenate(outs, axis=1)
+
+    def extract_activations(
+        self, prompts: Sequence[str], layer_idx: int, token_idx: int = -1
+    ) -> np.ndarray:
+        """[B, hidden] activations at one layer's output residual, at
+        ``token_idx`` of each (unpadded) prompt — reference semantics
+        (hook output[0][:, token_idx, :], model_utils.py:312-321)."""
+        return self.extract_activations_all_layers(prompts, token_idx)[layer_idx]
+
+    # -- generation ---------------------------------------------------------
+
+    def _generate(
+        self,
+        prompts: Sequence[str],
+        *,
+        max_new_tokens: int,
+        temperature: float,
+        layer_idx: int = 0,
+        steering_vectors: Optional[np.ndarray] = None,  # [B, H]
+        strength: float = 0.0,
+        steering_start_positions: Optional[Sequence[Optional[int]]] = None,
+        seed: Optional[int] = None,
+        debug: bool = False,
+    ) -> list[str]:
+        if not prompts:
+            return []
+        # Normalize negative layer indices (the reference's list indexing
+        # allows layer_idx=-1 to mean the last layer, model_utils.py:286);
+        # out-of-range must fail loudly, not silently disable steering.
+        if not -self.cfg.n_layers <= layer_idx < self.cfg.n_layers:
+            raise ValueError(
+                f"layer_idx {layer_idx} out of range for {self.cfg.n_layers} layers"
+            )
+        layer_idx = layer_idx % self.cfg.n_layers
+        ids, mask, lens, B = self._prep(prompts)
+        Bp, S = ids.shape
+        H = self.cfg.hidden_size
+
+        if steering_vectors is None:
+            vecs = np.zeros((Bp, H), np.float32)
+            strength = 0.0
+        else:
+            vecs = np.zeros((Bp, H), np.float32)
+            vecs[:B] = np.asarray(steering_vectors, np.float32)
+
+        # Left-pad adjustment: unpadded start -> padded coords
+        # (reference model_utils.py:819-825). None -> steer whole prompt.
+        starts = np.zeros((Bp,), np.int32)
+        if steering_start_positions is not None:
+            pad_amounts = S - lens
+            for i, sp in enumerate(steering_start_positions):
+                starts[i] = 0 if sp is None else pad_amounts[i] + int(sp)
+
+        spec = GenSpec(
+            rng=self._next_key(seed),
+            temperature=jnp.float32(temperature),
+            steer_layer=jnp.int32(layer_idx),
+            steer_strength=jnp.float32(strength),
+            steer_vectors=self._shard_batch(jnp.asarray(vecs)),
+            steer_start=self._shard_batch(jnp.asarray(starts)),
+            eos_ids=jnp.asarray(list(self.tokenizer.eos_ids), jnp.int32),
+            pad_id=jnp.int32(self.tokenizer.pad_id),
+        )
+        tokens = generate_tokens(
+            self.params, self.cfg, ids, mask, spec, max_new_tokens=max_new_tokens
+        )
+        tokens = np.asarray(tokens)
+        if debug:
+            steered_prompt = int(
+                ((np.arange(S)[None, :] >= starts[:B, None]) & (np.asarray(mask)[:B] > 0)).sum()
+            )
+            print(
+                f"[DEBUG] steered prompt positions={steered_prompt}, "
+                f"decode steps steered={max_new_tokens} x batch={B}, "
+                f"layer={layer_idx}, strength={strength}"
+            )
+        return [self._decode_row(tokens[i]) for i in range(B)]
+
+    def generate(
+        self, prompt: str, max_new_tokens: int = 512, temperature: float = 0.0,
+        seed: Optional[int] = None, **kw,
+    ) -> str:
+        return self._generate(
+            [prompt], max_new_tokens=max_new_tokens, temperature=temperature, seed=seed
+        )[0]
+
+    def generate_batch(
+        self, prompts: Sequence[str], max_new_tokens: int = 512,
+        temperature: float = 0.0, seed: Optional[int] = None, **kw,
+    ) -> list[str]:
+        return self._generate(
+            list(prompts), max_new_tokens=max_new_tokens, temperature=temperature,
+            seed=seed,
+        )
+
+    def generate_with_steering(
+        self,
+        prompt: str,
+        layer_idx: int,
+        steering_vector: np.ndarray,
+        strength: float = 1.0,
+        max_new_tokens: int = 512,
+        temperature: float = 0.0,
+        steering_start_pos: Optional[int] = None,
+        seed: Optional[int] = None,
+        **kw,
+    ) -> str:
+        return self._generate(
+            [prompt],
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            layer_idx=layer_idx,
+            steering_vectors=np.asarray(steering_vector)[None, :],
+            strength=strength,
+            steering_start_positions=[steering_start_pos],
+            seed=seed,
+        )[0]
+
+    def generate_batch_with_steering(
+        self,
+        prompts: Sequence[str],
+        layer_idx: int,
+        steering_vector: np.ndarray,
+        strength: float = 1.0,
+        max_new_tokens: int = 512,
+        temperature: float = 0.0,
+        steering_start_pos: Optional[int] = None,
+        seed: Optional[int] = None,
+        **kw,
+    ) -> list[str]:
+        """One shared vector for the whole batch (reference
+        model_utils.py:562-685 — including the branch its NameError bug
+        kills; see SURVEY.md §7.5, not replicated here)."""
+        B = len(prompts)
+        return self._generate(
+            list(prompts),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            layer_idx=layer_idx,
+            steering_vectors=np.tile(np.asarray(steering_vector)[None, :], (B, 1)),
+            strength=strength,
+            steering_start_positions=[steering_start_pos] * B,
+            seed=seed,
+        )
+
+    def generate_batch_with_multi_steering(
+        self,
+        prompts: Sequence[str],
+        layer_idx: int,
+        steering_vectors: Sequence[np.ndarray],
+        strength: float = 1.0,
+        max_new_tokens: int = 512,
+        temperature: float = 0.0,
+        steering_start_positions: Optional[Sequence[Optional[int]]] = None,
+        debug: bool = False,
+        seed: Optional[int] = None,
+        **kw,
+    ) -> list[str]:
+        """Per-prompt vectors — the sweep workhorse (reference
+        model_utils.py:687-879). No sequential fallback needed: the batched
+        path is a single traced program for every model family."""
+        assert len(prompts) == len(steering_vectors), (
+            "Must have one steering vector per prompt"
+        )
+        return self._generate(
+            list(prompts),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            layer_idx=layer_idx,
+            steering_vectors=np.stack([np.asarray(v) for v in steering_vectors]),
+            strength=strength,
+            steering_start_positions=steering_start_positions,
+            seed=seed,
+            debug=debug,
+        )
+
+    # -- misc ---------------------------------------------------------------
+
+    def get_layer_at_fraction(self, fraction: float) -> int:
+        return get_layer_at_fraction(self.n_layers, fraction)
+
+    def cleanup(self):
+        """Free params + compiled executables for model switchover (reference
+        model_utils.py:881-900; XLA analogue of cuda.empty_cache). Explicit
+        only — clear_caches() is process-global, so it must never run from
+        __del__ where GC timing would wipe another live runner's executables."""
+        self.params = None
+        gc.collect()
+        jax.clear_caches()
+
+    def __del__(self):
+        # Only drop our own references; never touch global caches here.
+        self.params = None

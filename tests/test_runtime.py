@@ -1,0 +1,124 @@
+"""ModelRunner: batch==single at temp 0, steering semantics, extraction
+correctness on ragged left-padded batches, sampling determinism."""
+
+import jax
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.models import (
+    ByteTokenizer,
+    init_params,
+    tiny_config,
+)
+from introspective_awareness_tpu.runtime import ModelRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.key(0))
+    return ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4,
+    )
+
+
+PROMPTS = [
+    "Trial 1: Do you detect an injected thought?",
+    "Tell me about Dust",
+    "Hello there, this is a somewhat longer prompt to force ragged padding.",
+]
+
+
+def test_batch_matches_single_greedy(runner):
+    """Batched generation == one-at-a-time generation, token for token, at
+    temp 0 (VERDICT round-1 next-step 3)."""
+    batch = runner.generate_batch(PROMPTS, max_new_tokens=8, temperature=0.0)
+    singles = [
+        runner.generate(p, max_new_tokens=8, temperature=0.0) for p in PROMPTS
+    ]
+    assert batch == singles
+
+
+def test_zero_strength_equals_unsteered(runner):
+    vecs = [np.ones((runner.cfg.hidden_size,), np.float32)] * len(PROMPTS)
+    steered0 = runner.generate_batch_with_multi_steering(
+        PROMPTS, layer_idx=2, steering_vectors=vecs, strength=0.0,
+        max_new_tokens=8, temperature=0.0,
+    )
+    plain = runner.generate_batch(PROMPTS, max_new_tokens=8, temperature=0.0)
+    assert steered0 == plain
+
+
+def test_steering_changes_output(runner):
+    rng = np.random.default_rng(0)
+    vecs = [rng.standard_normal(runner.cfg.hidden_size).astype(np.float32) * 10]
+    plain = runner.generate(PROMPTS[0], max_new_tokens=8, temperature=0.0)
+    steered = runner.generate_with_steering(
+        PROMPTS[0], layer_idx=2, steering_vector=vecs[0], strength=50.0,
+        max_new_tokens=8, temperature=0.0,
+    )
+    assert steered != plain
+
+
+def test_multi_steering_batch_matches_single(runner):
+    """Per-prompt vectors + per-prompt start positions, batched vs unbatched."""
+    rng = np.random.default_rng(1)
+    vecs = [
+        rng.standard_normal(runner.cfg.hidden_size).astype(np.float32)
+        for _ in PROMPTS
+    ]
+    starts = [3, None, 10]
+    batch = runner.generate_batch_with_multi_steering(
+        PROMPTS, layer_idx=1, steering_vectors=vecs, strength=6.0,
+        max_new_tokens=8, temperature=0.0, steering_start_positions=starts,
+    )
+    singles = [
+        runner.generate_with_steering(
+            p, layer_idx=1, steering_vector=v, strength=6.0,
+            max_new_tokens=8, temperature=0.0, steering_start_pos=s,
+        )
+        for p, v, s in zip(PROMPTS, vecs, starts)
+    ]
+    assert batch == singles
+
+
+def test_sampling_determinism(runner):
+    a = runner.generate_batch(PROMPTS, max_new_tokens=8, temperature=1.0, seed=7)
+    b = runner.generate_batch(PROMPTS, max_new_tokens=8, temperature=1.0, seed=7)
+    c = runner.generate_batch(PROMPTS, max_new_tokens=8, temperature=1.0, seed=8)
+    assert a == b
+    assert a != c  # overwhelmingly likely for 8 byte-tokens x 3 prompts
+
+
+def test_extract_activations_ragged_batch(runner):
+    """Activations for a prompt are identical whether extracted alone or in a
+    ragged batch (left-pad correctness of the capture index)."""
+    solo = runner.extract_activations([PROMPTS[1]], layer_idx=2)
+    batch = runner.extract_activations(PROMPTS, layer_idx=2)
+    np.testing.assert_allclose(batch[1], solo[0], rtol=2e-4, atol=2e-4)
+    assert batch.shape == (len(PROMPTS), runner.cfg.hidden_size)
+
+
+def test_extract_all_layers_shape(runner):
+    acts = runner.extract_activations_all_layers(PROMPTS)
+    assert acts.shape == (
+        runner.cfg.n_layers, len(PROMPTS), runner.cfg.hidden_size
+    )
+    # layer slice agrees with single-layer API
+    np.testing.assert_array_equal(
+        acts[1], runner.extract_activations(PROMPTS, layer_idx=1)
+    )
+
+
+def test_extract_token_idx(runner):
+    """token_idx indexes the unpadded prompt (reference hook token_idx)."""
+    # For a prompt whose encoding is the first k tokens of a longer prompt,
+    # capturing at token_idx=k-1 of the long prompt == last token of short one.
+    tok = runner.tokenizer
+    short = "abcdef"
+    long = "abcdefghij"
+    k = len(tok.encode(short))
+    a = runner.extract_activations([short], layer_idx=1, token_idx=-1)
+    b = runner.extract_activations([long], layer_idx=1, token_idx=k - 1)
+    np.testing.assert_allclose(a[0], b[0], rtol=2e-4, atol=2e-4)
