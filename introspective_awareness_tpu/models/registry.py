@@ -65,7 +65,11 @@ MODELS_WITHOUT_SYSTEM_ROLE = [
 
 def resolve_model_name(name: str) -> str:
     """Short name → HF repo id (unknown names pass through, like the reference
-    ``MODEL_NAME_MAP.get(model_name, model_name)``, model_utils.py:82)."""
+    ``MODEL_NAME_MAP.get(model_name, model_name)``, model_utils.py:82).
+
+    Rejects registry names whose architecture the decoder can't run yet, so a
+    sweep fails at config time rather than mid-run."""
+    check_supported(name)
     return MODEL_NAME_MAP.get(name, name)
 
 

@@ -404,7 +404,6 @@ def forward(
             # Prefill attends over the chunk only; decode over the full cache.
             k_att, v_att = (k, v) if is_prefill else (k_full, v_full)
         else:
-            k_full, v_full = k, v
             k_att, v_att = k, v
 
         amask = jnp.where(sliding, allowed_local, allowed) if cfg.sliding_window else allowed

@@ -1,0 +1,17 @@
+"""Sharded training step (next-token LM loss) over the device mesh.
+
+The reference never trains (it is inference-only, SURVEY.md §0); this package
+exists because a complete TPU framework needs a gradient path — for linear
+probes on captured activations, steering-vector finetuning, and judge-model
+adaptation — and because the multi-chip dry-run exercises the full
+dp/tp/ep-sharded backward pass + optimizer update.
+"""
+
+from introspective_awareness_tpu.training.train import (
+    TrainState,
+    init_train_state,
+    next_token_loss,
+    train_step,
+)
+
+__all__ = ["TrainState", "init_train_state", "next_token_loss", "train_step"]
