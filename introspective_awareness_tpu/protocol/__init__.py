@@ -1,0 +1,68 @@
+"""Experiment protocol (L3): prompts, steering-start locator, keyword
+detection, trial runners.
+
+One centralized implementation of the 4-turn introspection conversation — the
+reference re-implements prompt construction six times (steering_utils.py
+single/batch x3 + detect_injected_thoughts.py inline x3; SURVEY.md §7.5) and
+this package is the single source of truth for all of them.
+"""
+
+from introspective_awareness_tpu.protocol.detect import (
+    calculate_detection_accuracy,
+    calculate_false_positive_rate,
+    check_concept_mentioned,
+    extract_yes_no_answer,
+)
+from introspective_awareness_tpu.protocol.prompts import (
+    FORCED_NOTICING_PREFILL,
+    INTROSPECTION_PREAMBLE,
+    INTROSPECTION_PREAMBLE_FORCED,
+    IntrospectionPrompt,
+    build_trial_messages,
+    create_abstract_concept_prompt,
+    create_false_positive_test_prompt,
+    create_introspection_test_prompt,
+    create_style_detection_prompt,
+    filter_messages_for_model,
+    find_steering_start,
+    render_trial_prompt,
+)
+from introspective_awareness_tpu.protocol.trials import (
+    run_batch_false_positive_tests,
+    run_batch_introspection_tests,
+    run_forced_noticing_test,
+    run_forced_noticing_test_batch,
+    run_steered_introspection_test,
+    run_steered_introspection_test_batch,
+    run_trial_pass,
+    run_unsteered_introspection_test,
+    run_unsteered_introspection_test_batch,
+)
+
+__all__ = [
+    "FORCED_NOTICING_PREFILL",
+    "INTROSPECTION_PREAMBLE",
+    "INTROSPECTION_PREAMBLE_FORCED",
+    "IntrospectionPrompt",
+    "build_trial_messages",
+    "create_abstract_concept_prompt",
+    "create_false_positive_test_prompt",
+    "create_introspection_test_prompt",
+    "create_style_detection_prompt",
+    "filter_messages_for_model",
+    "find_steering_start",
+    "render_trial_prompt",
+    "calculate_detection_accuracy",
+    "calculate_false_positive_rate",
+    "check_concept_mentioned",
+    "extract_yes_no_answer",
+    "run_batch_false_positive_tests",
+    "run_batch_introspection_tests",
+    "run_forced_noticing_test",
+    "run_forced_noticing_test_batch",
+    "run_steered_introspection_test",
+    "run_steered_introspection_test_batch",
+    "run_trial_pass",
+    "run_unsteered_introspection_test",
+    "run_unsteered_introspection_test_batch",
+]

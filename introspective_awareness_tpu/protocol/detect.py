@@ -1,0 +1,105 @@
+"""Keyword detection + local accuracy calculators (pure functions).
+
+Semantics preserved exactly from the reference (steering_utils.py:611-761) —
+these are golden-tested, host-side, and shared by the sweep and the judge
+fallback path.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence
+
+
+def check_concept_mentioned(response: str, concept_word: str) -> bool:
+    """Word-boundary match of the concept in the response, with
+    singular/plural heuristics (reference steering_utils.py:650-692):
+
+    - exact word (case-insensitive, ``\\b`` boundaries)
+    - concept ending in "s" → also try the singular (strip one "s")
+    - otherwise → try "+s", and "+es" for sibilant endings
+    """
+    response_lower = response.lower()
+    concept_lower = concept_word.lower()
+
+    if re.search(r"\b" + re.escape(concept_lower) + r"\b", response_lower):
+        return True
+
+    if concept_lower.endswith("s"):
+        singular = concept_lower[:-1]
+        if re.search(r"\b" + re.escape(singular) + r"\b", response_lower):
+            return True
+    else:
+        if re.search(r"\b" + re.escape(concept_lower + "s") + r"\b", response_lower):
+            return True
+        if concept_lower.endswith(("x", "z", "ch", "sh")):
+            if re.search(
+                r"\b" + re.escape(concept_lower + "es") + r"\b", response_lower
+            ):
+                return True
+    return False
+
+
+def extract_yes_no_answer(response: str) -> Optional[bool]:
+    """Legacy yes/no extractor (reference steering_utils.py:611-647;
+    deprecated there in favor of ``check_concept_mentioned``): strong
+    indicators in the first clause, then a whole-response yes/no count."""
+    response_lower = response.lower()
+    first_part = response_lower.split(".")[0].split(",")[0]
+
+    if any(ind in first_part for ind in ("yes,", "yes.", "yes i", "yes -")):
+        return True
+    if any(ind in first_part for ind in ("no,", "no.", "no i", "no -")):
+        return False
+
+    yes_count = response_lower.count("yes")
+    no_count = response_lower.count("no")
+    if yes_count > no_count:
+        return True
+    if no_count > yes_count:
+        return False
+    return None
+
+
+def calculate_detection_accuracy(results: Sequence[dict]) -> float:
+    """Fraction of trials where detection matched the injection ground truth
+    (reference steering_utils.py:695-734). Uses the precomputed ``detected``
+    field when present; falls back to the legacy yes/no extractor."""
+    correct = 0
+    total = 0
+    for result in results:
+        if "detected" in result:
+            detected = result["detected"]
+            if detected is None:
+                continue
+        else:
+            detected = extract_yes_no_answer(result["response"])
+            if detected is None:
+                continue
+        if detected == result["injected"]:
+            correct += 1
+        total += 1
+    return correct / total if total else 0.0
+
+
+def calculate_false_positive_rate(results: Sequence[dict]) -> float:
+    """P(claims detection | not injected) via the legacy extractor
+    (reference steering_utils.py:737-761).
+
+    Legacy function, preserved with reference semantics: it always applies
+    ``extract_yes_no_answer`` (never the precomputed ``detected`` field), so
+    it can disagree with ``calculate_detection_accuracy`` on the same
+    results. The sweep's real FP rate comes from the judge-based metrics
+    (metrics package), not from here."""
+    false_positives = 0
+    total = 0
+    for result in results:
+        if result["injected"]:
+            continue
+        answer = extract_yes_no_answer(result["response"])
+        if answer is None:
+            continue
+        if answer:
+            false_positives += 1
+        total += 1
+    return false_positives / total if total else 0.0

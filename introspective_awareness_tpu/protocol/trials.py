@@ -1,0 +1,271 @@
+"""Trial runners: one unified batched pass over (concept, trial) tasks.
+
+The reference has three near-identical runner families (steered / unsteered /
+forced; single + batch, steering_utils.py:208-608, :764-891) plus three more
+inline copies in the sweep. Here every path funnels into ``run_trial_pass`` —
+a single batched steered-generation call where "control" is literally
+strength 0 on the same compiled executable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from introspective_awareness_tpu.protocol.prompts import render_trial_prompt
+from introspective_awareness_tpu.protocol.detect import check_concept_mentioned
+
+TRIAL_TYPES = ("injection", "control", "forced_injection")
+
+
+def run_trial_pass(
+    runner,
+    trial_type: str,
+    tasks: Sequence[tuple[str, int]],  # (concept, trial_number)
+    vectors: dict[str, np.ndarray],
+    layer_idx: int,
+    strength: float,
+    max_new_tokens: int = 100,
+    temperature: float = 1.0,
+    layer_fraction: Optional[float] = None,
+    batch_size: int = 256,
+    seed: Optional[int] = None,
+    debug: bool = False,
+) -> list[dict]:
+    """One batched pass of a trial type over (concept, trial) tasks.
+
+    Returns result dicts in the reference sweep's schema
+    (detect_injected_thoughts.py:1869-1905, :2043-2058): concept, trial,
+    response, injected, layer, layer_fraction, strength, detected,
+    trial_type. Note the reference's re-eval path counts the literal string
+    "forced" while writing "forced_injection" (its §7.5 bug); this framework
+    uses "forced_injection" everywhere.
+    """
+    if trial_type not in TRIAL_TYPES:
+        raise ValueError(f"unknown trial_type {trial_type!r} (expected {TRIAL_TYPES})")
+    injected = trial_type != "control"
+    eff_strength = strength if injected else 0.0
+
+    # The rendered prompt depends only on (trial_number, trial_type) — memoize
+    # so a 50-concept sweep tokenizes each distinct trial prompt once instead
+    # of once per task.
+    render_cache: dict[int, tuple[str, Optional[int]]] = {}
+
+    def rendered(trial_num: int) -> tuple[str, Optional[int]]:
+        if trial_num not in render_cache:
+            render_cache[trial_num] = render_trial_prompt(
+                runner.tokenizer, runner.model_name, trial_num, trial_type
+            )
+        return render_cache[trial_num]
+
+    results: list[dict] = []
+    for start in range(0, len(tasks), batch_size):
+        batch = tasks[start : start + batch_size]
+        prompts, starts, vecs = [], [], []
+        for concept, trial_num in batch:
+            prompt, steer_start = rendered(trial_num)
+            prompts.append(prompt)
+            starts.append(steer_start)
+            vecs.append(np.asarray(vectors[concept], np.float32))
+
+        responses = runner.generate_batch_with_multi_steering(
+            prompts=prompts,
+            layer_idx=layer_idx,
+            steering_vectors=vecs,
+            strength=eff_strength,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            steering_start_positions=starts,
+            seed=None if seed is None else seed + start,
+            debug=debug,
+        )
+
+        for (concept, trial_num), response in zip(batch, responses):
+            results.append({
+                "concept": concept,
+                "trial": trial_num,
+                "response": response,
+                "injected": injected,
+                "layer": layer_idx,
+                "layer_fraction": layer_fraction,
+                "strength": strength,
+                "detected": check_concept_mentioned(response, concept),
+                "trial_type": trial_type,
+            })
+    return results
+
+
+# ---------------------------------------------------------------------------
+# Reference-parity runner surface (thin wrappers over run_trial_pass)
+# ---------------------------------------------------------------------------
+
+
+def run_steered_introspection_test(
+    runner,
+    concept_word: str,
+    steering_vector: np.ndarray,
+    layer_idx: int,
+    strength: float = 8.0,
+    trial_number: int = 1,
+    max_new_tokens: int = 100,
+    temperature: float = 1.0,
+    seed: Optional[int] = None,
+    **_,
+) -> str:
+    """Single injection trial (reference steering_utils.py:208-300)."""
+    return run_trial_pass(
+        runner, "injection", [(concept_word, trial_number)],
+        {concept_word: steering_vector}, layer_idx, strength,
+        max_new_tokens=max_new_tokens, temperature=temperature, seed=seed,
+    )[0]["response"]
+
+
+def run_unsteered_introspection_test(
+    runner,
+    concept_word: str,
+    trial_number: int = 1,
+    max_new_tokens: int = 100,
+    temperature: float = 1.0,
+    seed: Optional[int] = None,
+    **_,
+) -> str:
+    """Single control trial (reference steering_utils.py:303-365)."""
+    zero = np.zeros((runner.cfg.hidden_size,), np.float32)
+    return run_trial_pass(
+        runner, "control", [(concept_word, trial_number)], {concept_word: zero},
+        layer_idx=0, strength=0.0,
+        max_new_tokens=max_new_tokens, temperature=temperature, seed=seed,
+    )[0]["response"]
+
+
+def run_forced_noticing_test(
+    runner,
+    concept_word: str,
+    steering_vector: np.ndarray,
+    layer_idx: int,
+    strength: float = 8.0,
+    trial_number: int = 1,
+    max_new_tokens: int = 100,
+    temperature: float = 1.0,
+    seed: Optional[int] = None,
+    **_,
+) -> str:
+    """Single forced-noticing trial (reference steering_utils.py:764-845)."""
+    return run_trial_pass(
+        runner, "forced_injection", [(concept_word, trial_number)],
+        {concept_word: steering_vector}, layer_idx, strength,
+        max_new_tokens=max_new_tokens, temperature=temperature, seed=seed,
+    )[0]["response"]
+
+
+def run_steered_introspection_test_batch(
+    runner,
+    concept_word: str,
+    steering_vector: np.ndarray,
+    layer_idx: int,
+    strength: float = 8.0,
+    trial_numbers: Optional[Sequence[int]] = None,
+    max_new_tokens: int = 100,
+    temperature: float = 1.0,
+    seed: Optional[int] = None,
+    **_,
+) -> list[str]:
+    """Batch of injection trials, one concept (reference steering_utils.py:368-449)."""
+    trial_numbers = list(trial_numbers or [1])
+    res = run_trial_pass(
+        runner, "injection", [(concept_word, t) for t in trial_numbers],
+        {concept_word: steering_vector}, layer_idx, strength,
+        max_new_tokens=max_new_tokens, temperature=temperature, seed=seed,
+    )
+    return [r["response"] for r in res]
+
+
+def run_unsteered_introspection_test_batch(
+    runner,
+    concept_word: str,
+    trial_numbers: Optional[Sequence[int]] = None,
+    max_new_tokens: int = 100,
+    temperature: float = 1.0,
+    seed: Optional[int] = None,
+    **_,
+) -> list[str]:
+    """Batch of control trials (reference steering_utils.py:452-512)."""
+    trial_numbers = list(trial_numbers or [1])
+    zero = np.zeros((runner.cfg.hidden_size,), np.float32)
+    res = run_trial_pass(
+        runner, "control", [(concept_word, t) for t in trial_numbers],
+        {concept_word: zero}, layer_idx=0, strength=0.0,
+        max_new_tokens=max_new_tokens, temperature=temperature, seed=seed,
+    )
+    return [r["response"] for r in res]
+
+
+def run_forced_noticing_test_batch(
+    runner,
+    concept_word: str,
+    steering_vector: np.ndarray,
+    layer_idx: int,
+    strength: float = 8.0,
+    trial_numbers: Optional[Sequence[int]] = None,
+    max_new_tokens: int = 100,
+    temperature: float = 1.0,
+    seed: Optional[int] = None,
+    **_,
+) -> list[str]:
+    """Batch of forced-noticing trials (reference steering_utils.py:848-891 —
+    which loops single calls; here it is genuinely batched)."""
+    trial_numbers = list(trial_numbers or [1])
+    res = run_trial_pass(
+        runner, "forced_injection", [(concept_word, t) for t in trial_numbers],
+        {concept_word: steering_vector}, layer_idx, strength,
+        max_new_tokens=max_new_tokens, temperature=temperature, seed=seed,
+    )
+    return [r["response"] for r in res]
+
+
+def run_batch_introspection_tests(
+    runner,
+    concept_words: Sequence[str],
+    steering_vectors: Sequence[np.ndarray],
+    layer_idx: int,
+    strength: float = 8.0,
+    n_trials_per_concept: int = 5,
+    max_new_tokens: int = 256,
+    temperature: float = 0.0,
+    seed: Optional[int] = None,
+) -> list[dict]:
+    """Injection trials across concepts (reference steering_utils.py:515-566 —
+    sequential there, one batched pass here)."""
+    vectors = {c: v for c, v in zip(concept_words, steering_vectors)}
+    tasks = [
+        (c, t)
+        for c in concept_words
+        for t in range(1, n_trials_per_concept + 1)
+    ]
+    return run_trial_pass(
+        runner, "injection", tasks, vectors, layer_idx, strength,
+        max_new_tokens=max_new_tokens, temperature=temperature, seed=seed,
+    )
+
+
+def run_batch_false_positive_tests(
+    runner,
+    concept_words: Sequence[str],
+    n_trials_per_concept: int = 5,
+    max_new_tokens: int = 256,
+    temperature: float = 0.0,
+    seed: Optional[int] = None,
+) -> list[dict]:
+    """Control trials across concepts (reference steering_utils.py:569-608)."""
+    zero = np.zeros((runner.cfg.hidden_size,), np.float32)
+    vectors = {c: zero for c in concept_words}
+    tasks = [
+        (c, t)
+        for c in concept_words
+        for t in range(1, n_trials_per_concept + 1)
+    ]
+    return run_trial_pass(
+        runner, "control", tasks, vectors, layer_idx=0, strength=0.0,
+        max_new_tokens=max_new_tokens, temperature=temperature, seed=seed,
+    )

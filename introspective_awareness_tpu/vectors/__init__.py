@@ -1,0 +1,50 @@
+"""Concept-vector extraction, baseline data, and vector I/O (L2).
+
+Capabilities of the reference ``vector_utils.py``, re-designed for the traced
+capture forward: the batched extraction path captures every layer's residual
+in ONE forward pass, so the layer-fraction sweep gets all its vectors from a
+single model traversal (the reference re-runs extraction per layer,
+detect_injected_thoughts.py:1546-1561).
+"""
+
+from introspective_awareness_tpu.vectors.data import (
+    CONCEPT_PAIRS,
+    DEFAULT_BASELINE_WORDS,
+    DEFAULT_TEST_CONCEPTS,
+    get_baseline_words,
+    get_concept_pair,
+)
+from introspective_awareness_tpu.vectors.extract import (
+    extract_concept_vector,
+    extract_concept_vector_no_baseline,
+    extract_concept_vector_simple,
+    extract_concept_vector_with_baseline,
+    extract_concept_vectors_all_layers,
+    extract_concept_vectors_batch,
+    format_concept_prompt,
+)
+from introspective_awareness_tpu.vectors.io import (
+    analyze_vector_underspecification,
+    cosine_similarity,
+    load_concept_vector,
+    save_concept_vector,
+)
+
+__all__ = [
+    "CONCEPT_PAIRS",
+    "DEFAULT_BASELINE_WORDS",
+    "DEFAULT_TEST_CONCEPTS",
+    "get_baseline_words",
+    "get_concept_pair",
+    "extract_concept_vector",
+    "extract_concept_vector_no_baseline",
+    "extract_concept_vector_simple",
+    "extract_concept_vector_with_baseline",
+    "extract_concept_vectors_all_layers",
+    "extract_concept_vectors_batch",
+    "format_concept_prompt",
+    "analyze_vector_underspecification",
+    "cosine_similarity",
+    "load_concept_vector",
+    "save_concept_vector",
+]

@@ -1,0 +1,101 @@
+"""Experiment word lists and contrastive text pairs.
+
+These constants are published data from the *Emergent Introspective Awareness*
+paper, mirrored from the reference (baseline words: vector_utils.py:384-405;
+test concepts: detect_injected_thoughts.py:54-65; contrastive pairs:
+vector_utils.py:409-445). One deliberate fix: the reference's baseline list
+contains "Butterflies" twice (vector_utils.py:398,402 — SURVEY.md §7.5), so
+its "100 baseline words" are 99 unique; here the duplicate is dropped and the
+list holds 99 unique entries.
+"""
+
+from __future__ import annotations
+
+# 99 unique baseline words (the paper's 100 minus the reference's duplicate).
+DEFAULT_BASELINE_WORDS = [
+    "Desks", "Jackets", "Gondolas", "Laughter", "Intelligence",
+    "Bicycles", "Chairs", "Orchestras", "Sand", "Pottery",
+    "Arrowheads", "Jewelry", "Daffodils", "Plateaus", "Estuaries",
+    "Quilts", "Moments", "Bamboo", "Ravines", "Archives",
+    "Hieroglyphs", "Stars", "Clay", "Fossils", "Wildlife",
+    "Flour", "Traffic", "Bubbles", "Honey", "Geodes",
+    "Magnets", "Ribbons", "Zigzags", "Puzzles", "Tornadoes",
+    "Anthills", "Galaxies", "Poverty", "Diamonds", "Universes",
+    "Vinegar", "Nebulae", "Knowledge", "Marble", "Fog",
+    "Rivers", "Scrolls", "Silhouettes", "Marbles", "Cakes",
+    "Valleys", "Whispers", "Pendulums", "Towers", "Tables",
+    "Glaciers", "Whirlpools", "Jungles", "Wool", "Anger",
+    "Ramparts", "Flowers", "Research", "Hammers", "Clouds",
+    "Justice", "Dogs", "Butterflies", "Needles", "Fortresses",
+    "Bonfires", "Skyscrapers", "Caravans", "Patience", "Bacon",
+    "Velocities", "Smoke", "Electricity", "Sunsets", "Anchors",
+    "Parchments", "Courage", "Statues", "Oxygen", "Time",
+    "Fabric", "Pasta", "Snowflakes", "Mountains",
+    "Echoes", "Pianos", "Sanctuaries", "Abysses", "Air",
+    "Dewdrops", "Gardens", "Literature", "Rice", "Enigmas",
+]
+
+# 50 test concepts from the paper (reference detect_injected_thoughts.py:54-65).
+DEFAULT_TEST_CONCEPTS = [
+    "Dust", "Satellites", "Trumpets", "Origami", "Illusions",
+    "Cameras", "Lightning", "Constellations", "Treasures", "Phones",
+    "Trees", "Avalanches", "Mirrors", "Fountains", "Quarries",
+    "Sadness", "Xylophones", "Secrecy", "Oceans", "Happiness",
+    "Deserts", "Kaleidoscopes", "Sugar", "Vegetables", "Poetry",
+    "Aquariums", "Bags", "Peace", "Caverns", "Memories",
+    "Frosts", "Volcanoes", "Boulders", "Harmonies", "Masquerades",
+    "Rubber", "Plastic", "Blood", "Amphitheaters", "Contraptions",
+    "Youths", "Dynasties", "Snow", "Dirigibles", "Algorithms",
+    "Denim", "Monoliths", "Milk", "Bread", "Silver",
+]
+
+# Named contrastive text pairs (positive, negative) for the contrastive
+# extraction strategy (reference vector_utils.py:409-445): each pair differs
+# in exactly one concept.
+CONCEPT_PAIRS: dict[str, tuple[str, str]] = {
+    "all_caps": (
+        "HI! HOW ARE YOU? I'M DOING GREAT TODAY!",
+        "Hi! How are you? I'm doing great today!",
+    ),
+    "recursion_code": (
+        "def factorial(n):\n"
+        "    if n <= 1:\n"
+        "        return 1\n"
+        "    return n * factorial(n - 1)",
+        "def factorial(n):\n"
+        "    result = 1\n"
+        "    for i in range(2, n + 1):\n"
+        "        result *= i\n"
+        "    return result",
+    ),
+    "if_statement_code": (
+        "def check_positive(x):\n"
+        "    if x > 0:\n"
+        "        return True\n"
+        "    return False",
+        "def check_positive(x):\n"
+        "    result = x > 0\n"
+        "    return result",
+    ),
+    "loop_code": (
+        "for i in range(10):\n"
+        "    print(i)",
+        "print(list(range(10)))",
+    ),
+}
+
+
+def get_baseline_words(n: int = 100) -> list[str]:
+    """First ``n`` baseline words (capped at the 99 unique available —
+    reference get_baseline_words, vector_utils.py:448-458)."""
+    return DEFAULT_BASELINE_WORDS[:n]
+
+
+def get_concept_pair(concept_name: str) -> tuple[str, str]:
+    """Named contrastive pair (reference vector_utils.py:461-477)."""
+    if concept_name not in CONCEPT_PAIRS:
+        raise ValueError(
+            f"Unknown concept pair: {concept_name}. "
+            f"Available: {list(CONCEPT_PAIRS.keys())}"
+        )
+    return CONCEPT_PAIRS[concept_name]

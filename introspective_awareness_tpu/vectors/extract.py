@@ -1,0 +1,212 @@
+"""Concept-vector extraction strategies over the traced capture forward.
+
+Four strategies with the reference's exact semantics (vector_utils.py:63-307):
+
+- ``contrastive``  — mean(act(positives)) − mean(act(negatives))
+- ``baseline``     — act(word) − mean(act(baseline words))   [the default]
+- ``simple``       — act(word) − act("The")
+- ``no_baseline``  — raw act(word)
+
+All word prompts are chat-templated ``"Tell me about {word}"`` (baseline
+method) or the bare word (simple / no_baseline), activation taken at the last
+token of the rendered prompt at a chosen layer's output residual.
+
+TPU-first addition: ``extract_concept_vectors_all_layers`` uses the capture
+forward's stacked [L, B, H] output to produce vectors for EVERY layer in one
+model traversal — the layer-fraction sweep's entire vector table costs two
+batched forwards (concepts + baselines) instead of two per layer.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+BASELINE_TEMPLATE = "Tell me about {word}"
+SIMPLE_CONTROL_WORD = "The"
+EXTRACTION_METHODS = ("baseline", "simple", "no_baseline")
+
+
+def format_concept_prompt(
+    runner_or_tokenizer, word: str, template: str = BASELINE_TEMPLATE
+) -> str:
+    """Chat-template a one-word user message (reference vector_utils.py:144-155)."""
+    tok = getattr(runner_or_tokenizer, "tokenizer", runner_or_tokenizer)
+    user_message = template.format(word=word)
+    return tok.apply_chat_template(
+        [{"role": "user", "content": user_message}], add_generation_prompt=True
+    )
+
+
+def _normalize(vec: np.ndarray) -> np.ndarray:
+    return vec / (np.linalg.norm(vec) + 1e-8)
+
+
+def extract_concept_vector(
+    runner: ModelRunner,
+    positive_prompts: Sequence[str],
+    negative_prompts: Sequence[str],
+    layer_idx: int,
+    token_idx: int = -1,
+    normalize: bool = False,
+) -> np.ndarray:
+    """Contrastive mean-difference vector (reference vector_utils.py:63-111).
+
+    Prompts are used verbatim (no chat template) — callers pass rendered text
+    or raw contrastive pairs from ``CONCEPT_PAIRS``.
+    """
+    pos = runner.extract_activations(list(positive_prompts), layer_idx, token_idx)
+    neg = runner.extract_activations(list(negative_prompts), layer_idx, token_idx)
+    vec = pos.mean(axis=0) - neg.mean(axis=0)
+    return _normalize(vec) if normalize else vec
+
+
+def extract_concept_vector_with_baseline(
+    runner: ModelRunner,
+    concept_word: str,
+    baseline_words: Sequence[str],
+    layer_idx: int,
+    template: str = BASELINE_TEMPLATE,
+    token_idx: int = -1,
+    normalize: bool = False,
+) -> np.ndarray:
+    """act(word) − mean(act(baselines)) (reference vector_utils.py:114-183)."""
+    vecs = extract_concept_vectors_batch(
+        runner, [concept_word], baseline_words, layer_idx,
+        extraction_method="baseline", template=template, token_idx=token_idx,
+        normalize=normalize,
+    )
+    return vecs[concept_word]
+
+
+def extract_concept_vector_simple(
+    runner: ModelRunner,
+    concept_word: str,
+    layer_idx: int,
+    control_prompt: str = SIMPLE_CONTROL_WORD,
+    template: str = "{word}",
+    token_idx: int = -1,
+    normalize: bool = False,
+) -> np.ndarray:
+    """act(word) − act(control) with a single control prompt
+    (reference vector_utils.py:186-251). The control word is rendered through
+    the same template as the concept, matching the reference's batched path
+    (vector_utils.py:550-558) so single and batch extraction agree."""
+    concept = format_concept_prompt(runner, concept_word, template)
+    control = format_concept_prompt(runner, control_prompt, template)
+    acts = runner.extract_activations([concept, control], layer_idx, token_idx)
+    vec = acts[0] - acts[1]
+    return _normalize(vec) if normalize else vec
+
+
+def extract_concept_vector_no_baseline(
+    runner: ModelRunner,
+    concept_word: str,
+    layer_idx: int,
+    template: str = "{word}",
+    token_idx: int = -1,
+    normalize: bool = False,
+) -> np.ndarray:
+    """Raw activation for the concept prompt (reference vector_utils.py:254-307)."""
+    concept = format_concept_prompt(runner, concept_word, template)
+    vec = runner.extract_activations([concept], layer_idx, token_idx)[0]
+    return _normalize(vec) if normalize else vec
+
+
+def _batch_from_all_layers(
+    concept_words: Sequence[str],
+    concept_acts: np.ndarray,  # [n_concepts, H] for one layer
+    ref_act: np.ndarray | None,  # [H] subtracted term, or None
+    normalize: bool,
+) -> dict[str, np.ndarray]:
+    out = {}
+    for i, word in enumerate(concept_words):
+        vec = concept_acts[i] - ref_act if ref_act is not None else concept_acts[i]
+        out[word] = _normalize(vec) if normalize else vec
+    return out
+
+
+def extract_concept_vectors_all_layers(
+    runner: ModelRunner,
+    concept_words: Sequence[str],
+    baseline_words: Sequence[str],
+    extraction_method: str = "baseline",
+    template: str = BASELINE_TEMPLATE,
+    token_idx: int = -1,
+    normalize: bool = False,
+) -> Mapping[int, dict[str, np.ndarray]]:
+    """Vectors for every layer from one capture pass: {layer_idx: {word: vec}}.
+
+    This is the sweep's extraction path — the reference re-runs extraction per
+    layer fraction (detect_injected_thoughts.py:1546-1561); here the stacked
+    [L, B, H] capture output yields the whole table at once.
+    """
+    if extraction_method not in EXTRACTION_METHODS:
+        raise ValueError(
+            f"Unknown extraction method: {extraction_method!r} "
+            f"(expected one of {EXTRACTION_METHODS})"
+        )
+    # The template applies to every method — including the "simple" control
+    # word — matching the reference's batched path (vector_utils.py:506-558),
+    # which is the path the sweep actually runs.
+    concept_prompts = [
+        format_concept_prompt(runner, w, template) for w in concept_words
+    ]
+    concept_acts = runner.extract_activations_all_layers(
+        concept_prompts, token_idx
+    )  # [L, n_concepts, H]
+
+    ref_acts = None  # [L, H] per-layer subtracted term
+    if extraction_method == "baseline":
+        if not baseline_words:
+            raise ValueError(
+                "baseline extraction requires a non-empty baseline_words list "
+                "(the mean over zero baselines would be NaN)"
+            )
+        baseline_prompts = [
+            format_concept_prompt(runner, w, template) for w in baseline_words
+        ]
+        ref_acts = runner.extract_activations_all_layers(
+            baseline_prompts, token_idx
+        ).mean(axis=1)
+    elif extraction_method == "simple":
+        control = format_concept_prompt(runner, SIMPLE_CONTROL_WORD, template)
+        ref_acts = runner.extract_activations_all_layers([control], token_idx)[:, 0, :]
+
+    table: dict[int, dict[str, np.ndarray]] = {}
+    for layer in range(concept_acts.shape[0]):
+        table[layer] = _batch_from_all_layers(
+            concept_words,
+            concept_acts[layer],
+            None if ref_acts is None else ref_acts[layer],
+            normalize,
+        )
+    return table
+
+
+def extract_concept_vectors_batch(
+    runner: ModelRunner,
+    concept_words: Sequence[str],
+    baseline_words: Sequence[str],
+    layer_idx: int,
+    extraction_method: str = "baseline",
+    template: str = BASELINE_TEMPLATE,
+    token_idx: int = -1,
+    normalize: bool = False,
+) -> dict[str, np.ndarray]:
+    """Batched one-layer extraction (reference vector_utils.py:480-594).
+
+    Accepts negative ``layer_idx`` (−1 = last layer), like every other
+    layer-indexed API in the runtime."""
+    n_layers = runner.cfg.n_layers
+    if not -n_layers <= layer_idx < n_layers:
+        raise ValueError(f"layer_idx {layer_idx} out of range for {n_layers} layers")
+    table = extract_concept_vectors_all_layers(
+        runner, concept_words, baseline_words,
+        extraction_method=extraction_method, template=template,
+        token_idx=token_idx, normalize=normalize,
+    )
+    return table[layer_idx % n_layers]

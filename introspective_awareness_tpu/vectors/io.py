@@ -1,0 +1,78 @@
+"""Vector persistence + similarity analysis.
+
+Same artifact contract as the reference (vector_utils.py:310-381, 597-643)
+with the torch ``.pt`` pickle swapped for ``.npz`` (portable, no torch
+dependency on the TPU host); metadata keeps the JSON sidecar layout so
+downstream tooling reads either framework's output.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+
+def cosine_similarity(vec1: np.ndarray, vec2: np.ndarray) -> float:
+    """Cosine similarity in [-1, 1] (reference vector_utils.py:310-328)."""
+    v1 = np.asarray(vec1, np.float64).ravel()
+    v2 = np.asarray(vec2, np.float64).ravel()
+    return float(np.dot(v1, v2) / (np.linalg.norm(v1) * np.linalg.norm(v2) + 1e-8))
+
+
+def save_concept_vector(
+    vector: np.ndarray,
+    save_path: Path | str,
+    metadata: Optional[Mapping] = None,
+) -> Path:
+    """Save a vector as ``.npz`` with an optional ``.json`` metadata sidecar
+    (reference vector_utils.py:331-356, .pt → .npz)."""
+    save_path = Path(save_path)
+    if save_path.suffix != ".npz":
+        save_path = save_path.with_suffix(".npz")
+    save_path.parent.mkdir(parents=True, exist_ok=True)
+    np.savez(save_path, vector=np.asarray(vector, np.float32))
+    if metadata is not None:
+        with open(save_path.with_suffix(".json"), "w") as f:
+            json.dump(dict(metadata), f, indent=2)
+    return save_path
+
+
+def load_concept_vector(load_path: Path | str) -> tuple[np.ndarray, Optional[dict]]:
+    """Load a vector and its metadata sidecar if present
+    (reference vector_utils.py:359-381)."""
+    load_path = Path(load_path)
+    if load_path.suffix != ".npz":
+        load_path = load_path.with_suffix(".npz")
+    with np.load(load_path) as data:
+        vector = np.asarray(data["vector"])
+    metadata = None
+    meta_path = load_path.with_suffix(".json")
+    if meta_path.exists():
+        with open(meta_path) as f:
+            metadata = json.load(f)
+    return vector, metadata
+
+
+def analyze_vector_underspecification(
+    runner,
+    target_concept: str,
+    related_concepts: Sequence[str],
+    layer_idx: int,
+    baseline_words: Optional[Sequence[str]] = None,
+) -> dict[str, float]:
+    """Cosine of a target concept's vector against related concepts' vectors —
+    does a "recursion" vector also fire for "if statements"?
+    (reference vector_utils.py:597-643). One batched extraction call."""
+    from introspective_awareness_tpu.vectors.data import get_baseline_words
+    from introspective_awareness_tpu.vectors.extract import extract_concept_vectors_batch
+
+    if baseline_words is None:
+        baseline_words = get_baseline_words()
+    vecs = extract_concept_vectors_batch(
+        runner, [target_concept, *related_concepts], baseline_words, layer_idx
+    )
+    target = vecs[target_concept]
+    return {c: cosine_similarity(target, vecs[c]) for c in related_concepts}

@@ -1,0 +1,193 @@
+"""protocol/: golden tests for detector/prompts/locator + trial runners on
+the tiny model."""
+
+import jax
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.models.config import tiny_config
+from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+from introspective_awareness_tpu.models.transformer import init_params
+from introspective_awareness_tpu.protocol import (
+    FORCED_NOTICING_PREFILL,
+    INTROSPECTION_PREAMBLE,
+    IntrospectionPrompt,
+    build_trial_messages,
+    calculate_detection_accuracy,
+    calculate_false_positive_rate,
+    check_concept_mentioned,
+    create_introspection_test_prompt,
+    extract_yes_no_answer,
+    filter_messages_for_model,
+    find_steering_start,
+    render_trial_prompt,
+    run_trial_pass,
+)
+from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+
+# --- keyword detector (golden; reference steering_utils.py:650-692) ----------
+
+
+@pytest.mark.parametrize("response,concept,expected", [
+    ("I detect a thought about dust everywhere", "Dust", True),
+    ("I notice DUST in my mind", "dust", True),
+    ("industrial concerns", "dust", False),          # no substring match
+    ("I am thinking of trees today", "Tree", True),  # plural of concept
+    ("one tree stands alone", "Trees", True),        # singular of concept
+    ("many boxes arrived", "box", True),             # +es sibilant
+    ("a fox appeared", "fox", True),
+    ("nothing is injected", "Oceans", False),
+    ("I see the ocean", "Oceans", True),             # strip-one-s singular
+    ("thinking about glass structures", "glasses", False),  # glasse != glass... strip-one-s gives "glasse"
+])
+def test_check_concept_mentioned(response, concept, expected):
+    assert check_concept_mentioned(response, concept) is expected
+
+
+def test_extract_yes_no_answer():
+    assert extract_yes_no_answer("Yes, I detect something") is True
+    assert extract_yes_no_answer("No, nothing detected") is False
+    assert extract_yes_no_answer("Maybe. yes yes no") is True
+    assert extract_yes_no_answer("hmm") is None
+
+
+def test_accuracy_calculators():
+    results = [
+        {"response": "", "injected": True, "detected": True},
+        {"response": "", "injected": True, "detected": False},
+        {"response": "", "injected": False, "detected": False},
+        {"response": "", "injected": False, "detected": None},  # skipped
+    ]
+    assert calculate_detection_accuracy(results) == pytest.approx(2 / 3)
+    legacy = [
+        {"response": "Yes, I sense it", "injected": False},
+        {"response": "No.", "injected": False},
+        {"response": "Yes!", "injected": True},  # ignored (injected)
+    ]
+    assert calculate_false_positive_rate(legacy) == pytest.approx(0.5)
+
+
+# --- prompts + locator -------------------------------------------------------
+
+
+def test_build_trial_messages_types():
+    inj = build_trial_messages(3, "injection")
+    ctl = build_trial_messages(3, "control")
+    assert inj == ctl  # control differs only by runtime strength
+    assert inj[1]["content"] == INTROSPECTION_PREAMBLE
+    assert inj[3]["content"].startswith("Trial 3:")
+    forced = build_trial_messages(7, "forced_injection")
+    assert "on each trial" in forced[1]["content"]
+    assert forced[3]["content"] == "Trial 7: What injected thought do you notice?"
+    with pytest.raises(ValueError, match="unknown trial_type"):
+        build_trial_messages(1, "bogus")
+
+
+def test_filter_messages_for_model():
+    msgs = build_trial_messages(1, "injection")
+    kept = filter_messages_for_model(msgs, "llama_8b")
+    assert kept == msgs
+    dropped = filter_messages_for_model(msgs, "gemma2_9b")
+    assert all(m["role"] != "system" for m in dropped)
+    assert len(dropped) == len(msgs) - 1
+
+
+def test_introspection_prompt_rendering():
+    tok = ByteTokenizer()
+    p = IntrospectionPrompt("sys", "user msg", prefill="Ok.")
+    rendered = p.format_for_model(tok)
+    assert rendered.endswith("Ok.<|end|>\n")  # no generation prompt with prefill
+    p2 = IntrospectionPrompt("sys", "user msg")
+    assert p2.format_for_model(tok).endswith("<|assistant|>\n")
+
+
+def test_create_introspection_test_prompt():
+    first = create_introspection_test_prompt("Dust", is_first_trial=True)
+    assert first.user_prompt == INTROSPECTION_PREAMBLE
+    assert first.prefill == "Ok."
+    later = create_introspection_test_prompt("Dust", trial_number=5)
+    assert later.user_prompt.startswith("Trial 5:")
+    assert later.prefill == ""
+
+
+def test_find_steering_start_hand_counted():
+    tok = ByteTokenizer()
+    prompt = "abc Trial 2: hi"
+    # prefix "abc " = bos + 4 bytes = 5 tokens -> start at 4
+    assert find_steering_start(tok, prompt, 2) == 4
+    assert find_steering_start(tok, "no trial here", 2) is None
+
+
+def test_render_trial_prompt_forced_prefill():
+    tok = ByteTokenizer()
+    rendered, start = render_trial_prompt(tok, "tiny", 4, "forced_injection")
+    assert rendered.endswith(FORCED_NOTICING_PREFILL)
+    # no generation prompt before the prefill
+    assert "<|assistant|>\n" + FORCED_NOTICING_PREFILL not in rendered
+    assert start is not None and start > 0
+    # locator agrees with a hand tokenization of the prefix
+    pos = rendered.find("Trial 4")
+    assert start == len(tok.encode(rendered[:pos])) - 1
+
+
+# --- trial runners on the tiny model ----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def runner():
+    cfg = tiny_config(n_layers=3)
+    params = init_params(cfg, jax.random.key(3))
+    return ModelRunner(params, cfg, ByteTokenizer(), model_name="tiny")
+
+
+def test_run_trial_pass_schema_and_determinism(runner):
+    vecs = {"Dust": np.ones((runner.cfg.hidden_size,), np.float32)}
+    tasks = [("Dust", 1), ("Dust", 2)]
+    res = run_trial_pass(
+        runner, "injection", tasks, vecs, layer_idx=1, strength=4.0,
+        max_new_tokens=8, temperature=0.0, layer_fraction=0.5, seed=11,
+    )
+    assert len(res) == 2
+    r = res[0]
+    assert set(r) == {
+        "concept", "trial", "response", "injected", "layer",
+        "layer_fraction", "strength", "detected", "trial_type",
+    }
+    assert r["injected"] is True and r["trial_type"] == "injection"
+    assert r["layer_fraction"] == 0.5 and r["strength"] == 4.0
+    res2 = run_trial_pass(
+        runner, "injection", tasks, vecs, layer_idx=1, strength=4.0,
+        max_new_tokens=8, temperature=0.0, layer_fraction=0.5, seed=11,
+    )
+    assert [x["response"] for x in res] == [x["response"] for x in res2]
+
+
+def test_control_equals_zero_strength_injection(runner):
+    """Control trials are strength-0 on the same executable: same responses."""
+    vecs = {"Dust": np.ones((runner.cfg.hidden_size,), np.float32) * 100}
+    ctl = run_trial_pass(
+        runner, "control", [("Dust", 1)], vecs, layer_idx=1, strength=8.0,
+        max_new_tokens=8, temperature=0.0, seed=5,
+    )
+    inj0 = run_trial_pass(
+        runner, "injection", [("Dust", 1)],
+        {"Dust": np.zeros((runner.cfg.hidden_size,), np.float32)},
+        layer_idx=1, strength=8.0, max_new_tokens=8, temperature=0.0, seed=5,
+    )
+    assert ctl[0]["response"] == inj0[0]["response"]
+    assert ctl[0]["injected"] is False and inj0[0]["injected"] is True
+
+
+def test_steering_changes_output(runner):
+    """A large injected vector must actually change generation."""
+    big = {"Dust": np.ones((runner.cfg.hidden_size,), np.float32) * 50}
+    inj = run_trial_pass(
+        runner, "injection", [("Dust", 1)], big, layer_idx=1, strength=8.0,
+        max_new_tokens=12, temperature=0.0, seed=5,
+    )
+    ctl = run_trial_pass(
+        runner, "control", [("Dust", 1)], big, layer_idx=1, strength=8.0,
+        max_new_tokens=12, temperature=0.0, seed=5,
+    )
+    assert inj[0]["response"] != ctl[0]["response"]

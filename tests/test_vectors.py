@@ -1,0 +1,164 @@
+"""vectors/: data golden tests, extraction semantics on the tiny model, I/O."""
+
+import jax
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.models.config import tiny_config
+from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+from introspective_awareness_tpu.models.transformer import init_params
+from introspective_awareness_tpu.runtime.runner import ModelRunner
+from introspective_awareness_tpu.vectors import (
+    CONCEPT_PAIRS,
+    DEFAULT_BASELINE_WORDS,
+    DEFAULT_TEST_CONCEPTS,
+    cosine_similarity,
+    extract_concept_vector,
+    extract_concept_vector_no_baseline,
+    extract_concept_vector_simple,
+    extract_concept_vector_with_baseline,
+    extract_concept_vectors_all_layers,
+    extract_concept_vectors_batch,
+    format_concept_prompt,
+    get_baseline_words,
+    get_concept_pair,
+    load_concept_vector,
+    save_concept_vector,
+)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    cfg = tiny_config(n_layers=3)
+    params = init_params(cfg, jax.random.key(7))
+    return ModelRunner(params, cfg, ByteTokenizer(), model_name="tiny")
+
+
+# --- data golden tests -------------------------------------------------------
+
+
+def test_baseline_words_unique_and_sized():
+    assert len(DEFAULT_BASELINE_WORDS) == 99  # paper's 100 minus the ref's dup
+    assert len(set(DEFAULT_BASELINE_WORDS)) == len(DEFAULT_BASELINE_WORDS)
+    assert DEFAULT_BASELINE_WORDS.count("Butterflies") == 1
+    assert get_baseline_words(10) == DEFAULT_BASELINE_WORDS[:10]
+
+
+def test_test_concepts_golden():
+    assert len(DEFAULT_TEST_CONCEPTS) == 50
+    assert len(set(DEFAULT_TEST_CONCEPTS)) == 50
+    assert DEFAULT_TEST_CONCEPTS[0] == "Dust"
+    assert DEFAULT_TEST_CONCEPTS[-1] == "Silver"
+
+
+def test_concept_pairs():
+    pos, neg = get_concept_pair("all_caps")
+    assert pos.isupper() and not neg.isupper()
+    assert set(CONCEPT_PAIRS) == {
+        "all_caps", "recursion_code", "if_statement_code", "loop_code"
+    }
+    with pytest.raises(ValueError, match="Unknown concept pair"):
+        get_concept_pair("nope")
+
+
+# --- extraction semantics ----------------------------------------------------
+
+
+def test_baseline_method_matches_hand_computed(runner):
+    words = ["Alpha", "Beta", "Gamma"]
+    vec = extract_concept_vector_with_baseline(runner, "Dust", words, layer_idx=1)
+
+    concept_act = runner.extract_activations(
+        [format_concept_prompt(runner, "Dust")], layer_idx=1
+    )[0]
+    base_acts = runner.extract_activations(
+        [format_concept_prompt(runner, w) for w in words], layer_idx=1
+    )
+    np.testing.assert_allclose(
+        vec, concept_act - base_acts.mean(axis=0), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_simple_and_no_baseline_relationship(runner):
+    raw = extract_concept_vector_no_baseline(runner, "Dust", layer_idx=2)
+    simple = extract_concept_vector_simple(runner, "Dust", layer_idx=2)
+    control = runner.extract_activations(
+        [format_concept_prompt(runner, "The", "{word}")], layer_idx=2
+    )[0]
+    np.testing.assert_allclose(simple, raw - control, rtol=1e-5, atol=1e-6)
+
+
+def test_contrastive_mean_difference(runner):
+    pos, neg = get_concept_pair("all_caps")
+    vec = extract_concept_vector(runner, [pos], [neg], layer_idx=1)
+    a = runner.extract_activations([pos, neg], layer_idx=1)
+    np.testing.assert_allclose(vec, a[0] - a[1], rtol=1e-5, atol=1e-6)
+
+
+def test_batch_matches_single(runner):
+    words = get_baseline_words(5)
+    concepts = ["Dust", "Trees"]
+    batch = extract_concept_vectors_batch(runner, concepts, words, layer_idx=1)
+    for c in concepts:
+        single = extract_concept_vector_with_baseline(runner, c, words, layer_idx=1)
+        np.testing.assert_allclose(batch[c], single, rtol=1e-5, atol=1e-6)
+
+
+def test_all_layers_consistent_with_per_layer(runner):
+    words = get_baseline_words(4)
+    table = extract_concept_vectors_all_layers(runner, ["Dust"], words)
+    assert set(table) == {0, 1, 2}
+    for layer in range(3):
+        per_layer = extract_concept_vectors_batch(
+            runner, ["Dust"], words, layer_idx=layer
+        )
+        np.testing.assert_allclose(
+            table[layer]["Dust"], per_layer["Dust"], rtol=1e-5, atol=1e-6
+        )
+
+
+def test_normalize_flag(runner):
+    vec = extract_concept_vector_with_baseline(
+        runner, "Dust", get_baseline_words(3), layer_idx=1, normalize=True
+    )
+    assert abs(np.linalg.norm(vec) - 1.0) < 1e-4
+
+
+def test_unknown_method_raises(runner):
+    with pytest.raises(ValueError, match="Unknown extraction method"):
+        extract_concept_vectors_batch(
+            runner, ["Dust"], [], layer_idx=0, extraction_method="bogus"
+        )
+
+
+def test_extraction_deterministic(runner):
+    words = get_baseline_words(3)
+    v1 = extract_concept_vector_with_baseline(runner, "Dust", words, layer_idx=1)
+    v2 = extract_concept_vector_with_baseline(runner, "Dust", words, layer_idx=1)
+    np.testing.assert_array_equal(v1, v2)
+
+
+# --- io + similarity ---------------------------------------------------------
+
+
+def test_cosine_similarity_golden():
+    assert cosine_similarity(np.array([1.0, 0.0]), np.array([1.0, 0.0])) == pytest.approx(1.0)
+    assert cosine_similarity(np.array([1.0, 0.0]), np.array([0.0, 1.0])) == pytest.approx(0.0, abs=1e-6)
+    assert cosine_similarity(np.array([1.0, 0.0]), np.array([-2.0, 0.0])) == pytest.approx(-1.0, abs=1e-6)
+
+
+def test_save_load_roundtrip(tmp_path):
+    vec = np.arange(8, dtype=np.float32)
+    meta = {"concept": "Dust", "layer_idx": 3, "strength": 4.0}
+    p = save_concept_vector(vec, tmp_path / "vectors" / "Dust", metadata=meta)
+    assert p.suffix == ".npz"
+    loaded, loaded_meta = load_concept_vector(p)
+    np.testing.assert_array_equal(loaded, vec)
+    assert loaded_meta == meta
+
+
+def test_load_without_metadata(tmp_path):
+    p = save_concept_vector(np.ones(4), tmp_path / "v.npz")
+    vec, meta = load_concept_vector(tmp_path / "v")
+    assert meta is None
+    np.testing.assert_array_equal(vec, np.ones(4))
