@@ -1,0 +1,46 @@
+"""LLM-as-judge grading (L4).
+
+Capabilities of the reference ``eval_utils.py`` grading stack: the six
+criteria prompt templates, an OpenAI-compatible async client with
+retry/backoff and bounded concurrency, the YES/NO and Grade parsers with
+their fallback chains, and the two-stage batch grading flow (claims-detection
+for everyone, concept-identification only for claimers).
+
+TPU addition (BASELINE.json north star "no GPU in the loop"): an on-device
+grader backend that runs the same grading prompts on a co-resident JAX model
+via ``ModelRunner`` instead of the OpenAI API.
+"""
+
+from introspective_awareness_tpu.judge.criteria import (
+    AFFIRMATIVE_RESPONSE_CRITERIA,
+    CLAIMS_DETECTION_CRITERIA,
+    COHERENCE_CRITERIA,
+    CORRECT_CONCEPT_IDENTIFICATION_CRITERIA,
+    CORRECT_IDENTIFICATION_CRITERIA,
+    GROUNDING_CRITERIA,
+    EvaluationCriteria,
+)
+from introspective_awareness_tpu.judge.client import (
+    JudgeClient,
+    OnDeviceJudgeClient,
+    OpenAIJudgeClient,
+)
+from introspective_awareness_tpu.judge.parsers import parse_grade, parse_yes_no
+from introspective_awareness_tpu.judge.judge import LLMJudge, batch_evaluate
+
+__all__ = [
+    "AFFIRMATIVE_RESPONSE_CRITERIA",
+    "CLAIMS_DETECTION_CRITERIA",
+    "COHERENCE_CRITERIA",
+    "CORRECT_CONCEPT_IDENTIFICATION_CRITERIA",
+    "CORRECT_IDENTIFICATION_CRITERIA",
+    "GROUNDING_CRITERIA",
+    "EvaluationCriteria",
+    "JudgeClient",
+    "OnDeviceJudgeClient",
+    "OpenAIJudgeClient",
+    "parse_grade",
+    "parse_yes_no",
+    "LLMJudge",
+    "batch_evaluate",
+]

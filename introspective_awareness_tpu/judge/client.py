@@ -1,0 +1,152 @@
+"""Judge backends: OpenAI-compatible async client + on-device TPU grader.
+
+``JudgeClient`` is the one-method seam between the grading flow and whatever
+answers grading prompts: the OpenAI API (reference behavior,
+eval_utils.py:236-404), a co-resident JAX model on the TPU mesh
+(BASELINE.json "no GPU in the loop" configuration), or a test fake.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Optional, Protocol, Sequence
+
+
+class JudgeClient(Protocol):
+    """Answers a batch of grading prompts; failures become "ERROR: ..." strings."""
+
+    def grade(self, prompts: Sequence[str]) -> list[str]: ...
+
+
+class OpenAIJudgeClient:
+    """Async fan-out against an OpenAI-compatible API.
+
+    Reference semantics (eval_utils.py:291-404): per-request timeout; up to
+    ``max_retries`` attempts with exponential backoff (1s, 2s, 4s) on
+    timeout / connection / rate-limit errors; other errors fail immediately;
+    every failure maps to an ``"ERROR: ..."`` string (never an exception);
+    an ``asyncio.Semaphore(max_concurrent)`` bounds in-flight requests; each
+    batch runs on a fresh event loop with a fresh client.
+    """
+
+    def __init__(
+        self,
+        model: str = "gpt-4.1-nano",
+        api_key: Optional[str] = None,
+        max_tokens: int = 500,
+        temperature: float = 0.0,
+        max_concurrent: int = 100,
+        max_retries: int = 3,
+        timeout: float = 30.0,
+        base_url: Optional[str] = None,
+    ):
+        self.model_name = model
+        self.max_tokens = max_tokens
+        self.temperature = temperature
+        self.max_concurrent = max_concurrent
+        self.max_retries = max_retries
+        self.timeout = timeout
+        self.base_url = base_url
+        self.api_key = api_key or os.environ.get("OPENAI_API_KEY")
+        if not self.api_key:
+            raise ValueError(
+                "API key required. Set OPENAI_API_KEY or pass api_key "
+                "(or use OnDeviceJudgeClient for the no-API configuration)."
+            )
+        try:
+            import openai  # noqa: F401
+        except ImportError as e:  # pragma: no cover - env without openai
+            raise ImportError(
+                "the openai package is required for OpenAIJudgeClient; "
+                "use OnDeviceJudgeClient to grade on-TPU without it"
+            ) from e
+
+    async def _call_one(self, client, prompt: str) -> str:
+        import openai
+
+        last_error: Exception | None = None
+        for attempt in range(self.max_retries):
+            try:
+                response = await asyncio.wait_for(
+                    client.chat.completions.create(
+                        model=self.model_name,
+                        max_tokens=self.max_tokens,
+                        temperature=self.temperature,
+                        messages=[{"role": "user", "content": prompt}],
+                        timeout=self.timeout,
+                    ),
+                    timeout=self.timeout + 5,
+                )
+                # content is Optional on OpenAI-compatible servers (content
+                # filters, some vLLM builds); the grade() contract is str.
+                return response.choices[0].message.content or ""
+            except asyncio.TimeoutError:
+                last_error = Exception(f"Request timeout after {self.timeout}s")
+            except (
+                openai.APIConnectionError,
+                openai.RateLimitError,
+                openai.APITimeoutError,
+            ) as e:
+                last_error = e
+            if attempt < self.max_retries - 1:
+                await asyncio.sleep(2**attempt)
+        raise last_error  # type: ignore[misc]
+
+    def grade(self, prompts: Sequence[str]) -> list[str]:
+        import openai
+
+        async def run_batch() -> list[str]:
+            client = openai.AsyncOpenAI(api_key=self.api_key, base_url=self.base_url)
+            try:
+                semaphore = asyncio.Semaphore(self.max_concurrent)
+
+                async def call(prompt: str) -> str:
+                    async with semaphore:
+                        try:
+                            return await self._call_one(client, prompt)
+                        except Exception as e:  # noqa: BLE001 - map to ERROR: string
+                            return f"ERROR: {e}"
+
+                return list(await asyncio.gather(*(call(p) for p in prompts)))
+            finally:
+                await client.close()
+
+        return asyncio.run(run_batch())
+
+
+class OnDeviceJudgeClient:
+    """Grade with a co-resident JAX model on the mesh — no API in the loop.
+
+    The grading prompt becomes a single chat-templated user turn answered
+    greedily (temp 0, matching the reference judge's temperature,
+    eval_utils.py:244). Co-residency: the grader's ModelRunner holds its own
+    sharded params on the same (or a sub-) mesh as the subject model; both
+    are plain pytrees, so XLA time-slices the chips between them.
+    """
+
+    def __init__(self, runner, max_tokens: int = 500, chunk_size: int = 64):
+        self.runner = runner
+        self.model_name = f"on-device:{runner.model_name}"
+        self.max_tokens = max_tokens
+        self.chunk_size = chunk_size
+
+    def grade(self, prompts: Sequence[str]) -> list[str]:
+        out: list[str] = []
+        for i in range(0, len(prompts), self.chunk_size):
+            chunk = prompts[i : i + self.chunk_size]
+            rendered = [
+                self.runner.tokenizer.apply_chat_template(
+                    [{"role": "user", "content": p}], add_generation_prompt=True
+                )
+                for p in chunk
+            ]
+            try:
+                out.extend(
+                    self.runner.generate_batch(
+                        rendered, max_new_tokens=self.max_tokens, temperature=0.0
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 - contract: ERROR: strings
+                out.extend([f"ERROR: {e}"] * len(chunk))
+        return out

@@ -1,0 +1,63 @@
+"""Judge-response parsers (pure functions, golden-tested).
+
+Fallback chains preserved exactly from the reference so graded artifacts are
+interchangeable: YES/NO (eval_utils.py:544-599) and Grade/Explanation
+(eval_utils.py:406-431).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+
+def parse_yes_no(response: str) -> Optional[bool]:
+    """YES/NO with four fallbacks: "Answer: X" → "the answer is X" → last
+    line → last word. ``None`` for ERROR: strings or unparseable output."""
+    if response.startswith("ERROR:"):
+        return None
+
+    match = re.search(r"Answer:\s*(YES|NO)", response, re.IGNORECASE)
+    if match:
+        return match.group(1).upper() == "YES"
+
+    answer_patterns = [
+        r"(?:therefore|thus|so),?\s+the\s+answer\s+is\s+(YES|NO)",
+        r"the\s+answer\s+(?:is|should be)\s+(YES|NO)",
+    ]
+    response_upper = response.upper()
+    for pattern in answer_patterns:
+        match = re.search(pattern, response_upper, re.IGNORECASE)
+        if match:
+            return match.group(1).upper() == "YES"
+
+    lines = response.strip().split("\n")
+    last_line = lines[-1].strip().upper()
+    if last_line == "YES":
+        return True
+    if last_line == "NO":
+        return False
+
+    words = response.strip().split()
+    if words:
+        last_word = words[-1].strip(".,!?;:").upper()
+        if last_word == "YES":
+            return True
+        if last_word == "NO":
+            return False
+    return None
+
+
+def parse_grade(response: str) -> tuple[Optional[int], str]:
+    """(grade, explanation) from "Grade: N / Explanation: ..." lines;
+    (None, full response) when the format is absent."""
+    try:
+        lines = response.strip().split("\n")
+        grade_line = next(l for l in lines if l.startswith("Grade:"))
+        explanation_line = next(l for l in lines if l.startswith("Explanation:"))
+        grade_str = grade_line.split("Grade:")[1].strip()
+        grade = int("".join(filter(str.isdigit, grade_str.split()[0])))
+        explanation = explanation_line.split("Explanation:")[1].strip()
+        return grade, explanation
+    except (StopIteration, ValueError, IndexError):
+        return None, response

@@ -1,0 +1,28 @@
+"""Signal-detection metrics + results persistence (L4 output side).
+
+Schema-compatible with the reference (eval_utils.py:838-1023, :894-935): the
+``results.json`` / CSV layout and every metric key match, so downstream
+comparison and plotting tools read either framework's artifacts.
+"""
+
+from introspective_awareness_tpu.metrics.metrics import (
+    compute_aggregate_metrics,
+    compute_detection_and_identification_metrics,
+)
+from introspective_awareness_tpu.metrics.persistence import (
+    config_dir,
+    load_evaluation_results,
+    results_to_csv,
+    save_evaluation_results,
+    vector_path,
+)
+
+__all__ = [
+    "compute_aggregate_metrics",
+    "compute_detection_and_identification_metrics",
+    "config_dir",
+    "load_evaluation_results",
+    "results_to_csv",
+    "save_evaluation_results",
+    "vector_path",
+]

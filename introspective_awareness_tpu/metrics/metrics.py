@@ -1,0 +1,130 @@
+"""Signal-detection metrics over judged trial results.
+
+Exact metric definitions from the reference
+(eval_utils.py:938-1023, BASELINE.md):
+
+- detection_hit_rate          = P(claims detection | injection)
+- detection_false_alarm_rate  = P(claims detection | control)
+- detection_accuracy          = (hits + correct rejections) / spontaneous
+- identification_accuracy_given_claim
+                              = P(correct ID | injection ∧ claimed)
+- combined_detection_and_identification_rate   [the headline metric]
+                              = P(claim ∧ correct ID | injection)
+- forced_identification_accuracy = P(correct ID | forced trial)
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def _claims(r: dict) -> bool:
+    return (
+        r.get("evaluations", {})
+        .get("claims_detection", {})
+        .get("claims_detection", False)
+    )
+
+
+def _identifies(r: dict) -> bool:
+    return (
+        r.get("evaluations", {})
+        .get("correct_concept_identification", {})
+        .get("correct_identification", False)
+    )
+
+
+def compute_detection_and_identification_metrics(
+    evaluated_results: Sequence[dict],
+) -> dict:
+    """Reference-schema metrics dict (eval_utils.py:938-1023). Trial types
+    are matched on the canonical strings — including "forced_injection"
+    (the reference's re-eval path counts "forced" and silently gets 0; that
+    §7.5 bug is not replicated)."""
+    injection = [
+        r for r in evaluated_results
+        if r.get("injected") and r.get("trial_type") == "injection"
+    ]
+    control = [
+        r for r in evaluated_results
+        if not r.get("injected") and r.get("trial_type") == "control"
+    ]
+    forced = [r for r in evaluated_results if r.get("trial_type") == "forced_injection"]
+
+    metrics: dict = {
+        "n_total": len(evaluated_results),
+        "n_injection": len(injection),
+        "n_control": len(control),
+        "n_forced": len(forced),
+    }
+
+    hits = sum(1 for r in injection if _claims(r))
+    false_alarms = sum(1 for r in control if _claims(r))
+
+    metrics["detection_hit_rate"] = hits / len(injection) if injection else 0.0
+    metrics["detection_false_alarm_rate"] = (
+        false_alarms / len(control) if control else 0.0
+    )
+
+    spontaneous = len(injection) + len(control)
+    if spontaneous:
+        correct_rejections = len(control) - false_alarms
+        metrics["detection_accuracy"] = (hits + correct_rejections) / spontaneous
+    else:
+        metrics["detection_accuracy"] = 0.0
+
+    claimed = [r for r in injection if _claims(r)]
+    metrics["identification_accuracy_given_claim"] = (
+        sum(1 for r in claimed if _identifies(r)) / len(claimed) if claimed else None
+    )
+
+    metrics["combined_detection_and_identification_rate"] = (
+        sum(1 for r in injection if _claims(r) and _identifies(r)) / len(injection)
+        if injection
+        else 0.0
+    )
+
+    metrics["forced_identification_accuracy"] = (
+        sum(1 for r in forced if _identifies(r)) / len(forced) if forced else None
+    )
+    return metrics
+
+
+def compute_aggregate_metrics(evaluated_results: Sequence[dict]) -> dict:
+    """Legacy four-criteria aggregates (reference eval_utils.py:838-891)."""
+    metrics = {
+        "n_samples": len(evaluated_results),
+        "coherence_mean": 0.0,
+        "affirmative_rate": 0.0,
+        "accuracy": 0.0,
+        "grounding_mean": 0.0,
+    }
+    if not evaluated_results:
+        return metrics
+
+    buckets: dict[str, list] = {
+        "coherence": [],
+        "affirmative_response": [],
+        "correct_identification": [],
+        "grounding": [],
+    }
+    for result in evaluated_results:
+        evals = result.get("evaluations", {})
+        for key, grades in buckets.items():
+            grade = evals.get(key, {}).get("grade")
+            if grade is not None:
+                grades.append(grade)
+
+    if buckets["coherence"]:
+        metrics["coherence_mean"] = sum(buckets["coherence"]) / len(buckets["coherence"])
+    if buckets["affirmative_response"]:
+        metrics["affirmative_rate"] = sum(buckets["affirmative_response"]) / len(
+            buckets["affirmative_response"]
+        )
+    if buckets["correct_identification"]:
+        metrics["accuracy"] = sum(buckets["correct_identification"]) / len(
+            buckets["correct_identification"]
+        )
+    if buckets["grounding"]:
+        metrics["grounding_mean"] = sum(buckets["grounding"]) / len(buckets["grounding"])
+    return metrics

@@ -1,0 +1,102 @@
+"""Results persistence: the artifact contract of the sweep.
+
+Layout matches the reference exactly (detect_injected_thoughts.py:1651-1652,
+:1779-1787, :2135-2157; eval_utils.py:894-935):
+
+    <out>/<model>/layer_{f:.2f}_strength_{s}/results.json   {results, metrics, n_samples}
+    <out>/<model>/layer_{f:.2f}_strength_{s}/results.csv    flat trial table
+    <out>/<model>/vectors/layer_{f:.2f}/{Concept}.npz       (+ .json metadata)
+
+``results.json`` existence is the sweep's resume/completion marker, so this
+layout IS the failure-recovery mechanism (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Mapping, Optional, Sequence
+
+
+def config_dir(
+    output_dir: Path | str, model_name: str, layer_fraction: float, strength: float
+) -> Path:
+    """<out>/<model>/layer_{f:.2f}_strength_{s}/ (reference
+    detect_injected_thoughts.py:1651-1652)."""
+    return (
+        Path(output_dir)
+        / model_name.replace("/", "_")
+        / f"layer_{layer_fraction:.2f}_strength_{strength}"
+    )
+
+
+def vector_path(
+    output_dir: Path | str, model_name: str, layer_fraction: float, concept: str
+) -> Path:
+    """<out>/<model>/vectors/layer_{f:.2f}/{Concept}.npz (reference
+    detect_injected_thoughts.py:1779-1787, .pt → .npz)."""
+    return (
+        Path(output_dir)
+        / model_name.replace("/", "_")
+        / "vectors"
+        / f"layer_{layer_fraction:.2f}"
+        / f"{concept}.npz"
+    )
+
+
+def save_evaluation_results(
+    results: Sequence[dict],
+    save_path: Path | str,
+    metrics: Optional[Mapping] = None,
+) -> None:
+    """{results, metrics, n_samples} JSON (reference eval_utils.py:894-919)."""
+    save_path = Path(save_path)
+    save_path.parent.mkdir(parents=True, exist_ok=True)
+    output = {
+        "results": list(results),
+        "metrics": dict(metrics or {}),
+        "n_samples": len(results),
+    }
+    with open(save_path, "w") as f:
+        json.dump(output, f, indent=2)
+
+
+def load_evaluation_results(load_path: Path | str) -> tuple[list[dict], dict]:
+    """(results, metrics) from results.json (reference eval_utils.py:922-935)."""
+    with open(load_path) as f:
+        data = json.load(f)
+    return data["results"], data.get("metrics", {})
+
+
+def results_to_csv(results: Sequence[dict], save_path: Path | str) -> None:
+    """Flat trial table (reference detect_injected_thoughts.py:2136-2137 uses
+    pandas; plain csv here keeps the artifact identical without the import).
+    Nested ``evaluations`` dicts are flattened to the two judge verdicts."""
+    import csv
+
+    save_path = Path(save_path)
+    save_path.parent.mkdir(parents=True, exist_ok=True)
+
+    rows = []
+    for r in results:
+        row = {k: v for k, v in r.items() if k != "evaluations"}
+        evals = r.get("evaluations")
+        if evals:
+            row["judge_claims_detection"] = evals.get("claims_detection", {}).get(
+                "claims_detection"
+            )
+            row["judge_correct_identification"] = evals.get(
+                "correct_concept_identification", {}
+            ).get("correct_identification")
+        rows.append(row)
+
+    fieldnames: list[str] = []
+    for row in rows:
+        for k in row:
+            if k not in fieldnames:
+                fieldnames.append(k)
+
+    with open(save_path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=fieldnames)
+        writer.writeheader()
+        writer.writerows(rows)
