@@ -55,6 +55,7 @@ class ModelConfig:
     # (Gemma-2: pattern 2 = alternate; Gemma-3: pattern 6).
     sliding_window_pattern: int = 2
     norm_scale_plus_one: bool = False  # Gemma RMSNorm multiplies by (1 + w)
+    mlp_activation: str = "silu"  # "silu" (llama/qwen) | "gelu_tanh" (gemma)
     rope_scaling: RopeScaling | None = None
     max_position: int = 8192
     # MoE (0 experts = dense MLP)
@@ -139,6 +140,10 @@ def config_from_hf(hf: Mapping[str, Any]) -> ModelConfig:
         else:
             raise ValueError(f"unsupported rope_scaling type: {rope_type!r}")
 
+    # HF's save path drops tie_word_embeddings from config.json when it
+    # equals the model class default — which is True for the Gemma families —
+    # so the fallback must be per-family, not a blanket False.
+    tie_default = model_type in ("gemma2", "gemma3", "gemma3_text")
     common = dict(
         vocab_size=hf["vocab_size"],
         hidden_size=hidden,
@@ -149,7 +154,7 @@ def config_from_hf(hf: Mapping[str, Any]) -> ModelConfig:
         mlp_hidden=hf["intermediate_size"],
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_eps=hf.get("rms_norm_eps", 1e-5),
-        tie_embeddings=hf.get("tie_word_embeddings", False),
+        tie_embeddings=hf.get("tie_word_embeddings", tie_default),
         rope_scaling=rope_scaling,
         max_position=hf.get("max_position_embeddings", 8192),
     )
@@ -176,6 +181,7 @@ def config_from_hf(hf: Mapping[str, Any]) -> ModelConfig:
             use_post_norms=True,
             embed_scale=True,
             norm_scale_plus_one=True,
+            mlp_activation="gelu_tanh",
             query_scale=hf.get("query_pre_attn_scalar", 224) ** -0.5,
             sliding_window=hf.get("sliding_window", 4096),
             sliding_window_pattern=2,
@@ -187,6 +193,7 @@ def config_from_hf(hf: Mapping[str, Any]) -> ModelConfig:
             use_qk_norm=True,
             embed_scale=True,
             norm_scale_plus_one=True,
+            mlp_activation="gelu_tanh",
             query_scale=hf.get("query_pre_attn_scalar", 256) ** -0.5,
             sliding_window=hf.get("sliding_window", 1024),
             sliding_window_pattern=hf.get("sliding_window_pattern", 6),

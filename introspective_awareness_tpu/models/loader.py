@@ -1,0 +1,243 @@
+"""HF safetensors checkpoint → stacked, GSPMD-sharded parameter pytree.
+
+Replaces the reference's ``AutoModelForCausalLM.from_pretrained(
+device_map="auto")`` path (model_utils.py:61-136): weights stream from the
+checkpoint's safetensors shards directly into our scan-stacked layout, and
+each stacked parameter is ``device_put`` with its logical-axis sharding — no
+single device (or the host, beyond one stacked parameter at a time)
+materializes the full model, which is what 70B+ checkpoints require
+(SURVEY.md §7.4.4).
+
+Name mapping is per-family but small because the decoder families share the
+HF naming scheme; weights are transposed from HF's [out, in] to the [in, out]
+einsum layout used by ``transformer.forward``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from introspective_awareness_tpu.models.config import ModelConfig, config_from_hf
+from introspective_awareness_tpu.models.tokenizer import HFTokenizer
+from introspective_awareness_tpu.models.transformer import param_logical_axes
+from introspective_awareness_tpu.parallel import ShardingRules
+from introspective_awareness_tpu.parallel import sharding as shax
+
+
+class CheckpointReader:
+    """Random access to tensors across a checkpoint's safetensors shards."""
+
+    def __init__(self, ckpt_dir: Path):
+        from safetensors import safe_open
+
+        self._safe_open = safe_open
+        self.dir = Path(ckpt_dir)
+        index_path = self.dir / "model.safetensors.index.json"
+        if index_path.exists():
+            with open(index_path) as f:
+                self.weight_map: dict[str, str] = json.load(f)["weight_map"]
+        else:
+            files = sorted(self.dir.glob("*.safetensors"))
+            if not files:
+                raise FileNotFoundError(f"no .safetensors files under {self.dir}")
+            self.weight_map = {}
+            for file in files:
+                with safe_open(file, framework="pt") as fh:
+                    for key in fh.keys():
+                        self.weight_map[key] = file.name
+        # Multimodal checkpoints (Gemma-3 4B+) nest the decoder under a
+        # "language_model." prefix; strip it so one name scheme serves both
+        # (the text_config unwrap in config_from_hf handles the config side).
+        if "model.embed_tokens.weight" not in self.weight_map:
+            prefixed = {
+                k: v for k, v in self.weight_map.items()
+                if k.startswith("language_model.")
+            }
+            if "language_model.model.embed_tokens.weight" in prefixed:
+                self.weight_map = {
+                    k[len("language_model."):]: v for k, v in prefixed.items()
+                }
+        self._handles: dict[str, Any] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.weight_map
+
+    def get(self, name: str) -> np.ndarray:
+        # torch framework handles every checkpoint dtype incl. bf16/fp8
+        # (numpy's safetensors backend cannot represent bf16).
+        import torch
+
+        file = self.weight_map[name]
+        if file not in self._handles:
+            self._handles[file] = self._safe_open(
+                self.dir / file, framework="pt"
+            ).__enter__()
+        t = self._handles[file].get_tensor(name)
+        if t.dtype not in (torch.float32, torch.float64, torch.float16):
+            t = t.float()
+        return t.numpy()
+
+    def close(self) -> None:
+        for h in self._handles.values():
+            h.__exit__(None, None, None)
+        self._handles.clear()
+
+
+def _hf_layer_names(cfg: ModelConfig) -> dict[str, Callable[[int], list[str]]]:
+    """Our stacked-layer key → HF tensor name(s) for layer i.
+
+    Multi-name entries (MoE experts) are stacked on a new leading dim.
+    Families covered: llama/mistral, qwen2 (bias), qwen3 (+qk-norm),
+    qwen3_moe, gemma2/gemma3 (extra norms). HF reference naming per
+    ``transformers`` modeling files; the reference loads these same
+    checkpoints via AutoModel (model_utils.py:117).
+    """
+    p = "model.layers.{i}."
+    names: dict[str, Any] = {
+        "wq": p + "self_attn.q_proj.weight",
+        "wk": p + "self_attn.k_proj.weight",
+        "wv": p + "self_attn.v_proj.weight",
+        "wo": p + "self_attn.o_proj.weight",
+        "attn_norm": p + "input_layernorm.weight",
+    }
+    if cfg.use_post_norms:  # Gemma-2/3 four-norm block
+        names["post_attn_norm"] = p + "post_attention_layernorm.weight"
+        names["mlp_norm"] = p + "pre_feedforward_layernorm.weight"
+        names["post_mlp_norm"] = p + "post_feedforward_layernorm.weight"
+    else:
+        names["mlp_norm"] = p + "post_attention_layernorm.weight"
+    if cfg.qkv_bias:
+        names["bq"] = p + "self_attn.q_proj.bias"
+        names["bk"] = p + "self_attn.k_proj.bias"
+        names["bv"] = p + "self_attn.v_proj.bias"
+    if cfg.use_qk_norm:
+        names["q_norm"] = p + "self_attn.q_norm.weight"
+        names["k_norm"] = p + "self_attn.k_norm.weight"
+    if cfg.is_moe:
+        names["router"] = p + "mlp.gate.weight"
+        names["w_gate"] = [
+            p + f"mlp.experts.{e}.gate_proj.weight" for e in range(cfg.n_experts)
+        ]
+        names["w_up"] = [
+            p + f"mlp.experts.{e}.up_proj.weight" for e in range(cfg.n_experts)
+        ]
+        names["w_down"] = [
+            p + f"mlp.experts.{e}.down_proj.weight" for e in range(cfg.n_experts)
+        ]
+    else:
+        names["w_gate"] = p + "mlp.gate_proj.weight"
+        names["w_up"] = p + "mlp.up_proj.weight"
+        names["w_down"] = p + "mlp.down_proj.weight"
+    return names
+
+# Linear weights stored [out, in] by HF; our einsums use [in, out].
+_TRANSPOSED = {"wq", "wk", "wv", "wo", "router", "w_gate", "w_up", "w_down"}
+# Norm scales and biases are 1-D, taken as-is.
+
+
+def load_params(
+    ckpt_dir: Path | str,
+    cfg: ModelConfig,
+    mesh=None,
+    rules: ShardingRules | None = None,
+    dtype=jnp.bfloat16,
+) -> dict:
+    """Read a checkpoint directory into the stacked-params pytree.
+
+    With ``mesh``, every parameter lands sharded per its logical axes; the
+    host peak is one stacked parameter (the layer stack of a single weight),
+    freed before the next is read.
+    """
+    reader = CheckpointReader(Path(ckpt_dir))
+    rules = rules or ShardingRules()
+    axes = param_logical_axes(cfg)
+
+    def put(arr: np.ndarray, logical: tuple) -> jax.Array:
+        # Cast on HOST (ml_dtypes covers bf16), then device_put with the
+        # target sharding — each device receives only its shard. jnp.asarray
+        # first would commit the full stacked parameter to device 0, which
+        # OOMs exactly for the 70B+ case this loader exists for.
+        arr = np.asarray(arr).astype(np.dtype(dtype))
+        if mesh is None:
+            return jnp.asarray(arr)
+        return jax.device_put(
+            arr, shax.logical_to_sharding(tuple(logical), mesh, rules)
+        )
+
+    def read_stacked(key: str, template) -> np.ndarray:
+        per_layer = []
+        for i in range(cfg.n_layers):
+            if isinstance(template, list):  # MoE: stack experts below layers
+                tensors = [reader.get(t.format(i=i)) for t in template]
+                t = np.stack(
+                    [x.T if key in _TRANSPOSED else x for x in tensors], axis=0
+                )
+            else:
+                t = reader.get(template.format(i=i))
+                if key in _TRANSPOSED:
+                    t = t.T
+            per_layer.append(t)
+        return np.stack(per_layer, axis=0)
+
+    try:
+        embed = reader.get("model.embed_tokens.weight")
+        params: dict[str, Any] = {"embed": put(embed, axes["embed"])}
+
+        layer_axes = axes["layers"]
+        layers: dict[str, Any] = {}
+        for key, template in _hf_layer_names(cfg).items():
+            layers[key] = put(read_stacked(key, template), layer_axes[key])
+        params["layers"] = layers
+
+        params["final_norm"] = put(reader.get("model.norm.weight"), axes["final_norm"])
+        if not cfg.tie_embeddings:
+            if "lm_head.weight" in reader:
+                params["lm_head"] = put(
+                    reader.get("lm_head.weight").T, axes["lm_head"]
+                )
+            else:  # checkpoint ties even though config says otherwise
+                params["lm_head"] = put(embed.T, axes["lm_head"])
+        return params
+    finally:
+        reader.close()
+
+
+def load_model(
+    model_path: Path | str,
+    mesh=None,
+    rules: ShardingRules | None = None,
+    dtype=jnp.bfloat16,
+    model_name: str = "",
+    **runner_kwargs,
+):
+    """Checkpoint directory → ready ``ModelRunner`` (config + tokenizer +
+    sharded params). Counterpart of the reference ``load_model``
+    (model_utils.py:919-966)."""
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    import dataclasses
+
+    model_path = Path(model_path)
+    with open(model_path / "config.json") as f:
+        cfg = config_from_hf(json.load(f))
+    # HF omits tie_word_embeddings from config.json when it equals the model
+    # class default, so trust the checkpoint: no lm_head tensor ⇒ tied.
+    if not cfg.tie_embeddings:
+        reader = CheckpointReader(model_path)
+        tied = "lm_head.weight" not in reader
+        reader.close()
+        if tied:
+            cfg = dataclasses.replace(cfg, tie_embeddings=True)
+    params = load_params(model_path, cfg, mesh=mesh, rules=rules, dtype=dtype)
+    tokenizer = HFTokenizer(str(model_path))
+    return ModelRunner(
+        params, cfg, tokenizer,
+        model_name=model_name or str(model_path),
+        mesh=mesh, rules=rules, **runner_kwargs,
+    )

@@ -200,6 +200,14 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float, plus_one: bool) -> jax.Arra
     return (xf * scale).astype(dt)
 
 
+def mlp_act(x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Gated-MLP activation: SiLU (llama/qwen) or tanh-approx GELU (gemma,
+    matching HF's gelu_pytorch_tanh)."""
+    if cfg.mlp_activation == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
 def rope_inv_freq(cfg: ModelConfig, local: bool = False) -> jax.Array:
     theta = cfg.rope_theta_local if (local and cfg.rope_theta_local) else cfg.rope_theta
     d = cfg.head_dim
@@ -419,7 +427,7 @@ def forward(
         else:
             gate = jnp.einsum("bsh,hm->bsm", x, lp["w_gate"])
             up = jnp.einsum("bsh,hm->bsm", x, lp["w_up"])
-            mlp = jnp.einsum("bsm,mh->bsh", jax.nn.silu(gate) * up, lp["w_down"])
+            mlp = jnp.einsum("bsm,mh->bsh", mlp_act(gate, cfg) * up, lp["w_down"])
         if cfg.use_post_norms:
             mlp = rms_norm(mlp, lp["post_mlp_norm"], cfg.rms_eps, plus1)
         h = h + mlp
@@ -489,7 +497,7 @@ def _moe_mlp(x: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
     )  # [B, S, E]
     gate = jnp.einsum("bsh,ehm->ebsm", x, lp["w_gate"])
     up = jnp.einsum("bsh,ehm->ebsm", x, lp["w_up"])
-    act = jax.nn.silu(gate) * up
+    act = mlp_act(gate, cfg) * up
     eo = jnp.einsum("ebsm,emh->ebsh", act, lp["w_down"])
     return jnp.einsum("ebsh,bse->bsh", eo, combine)
 

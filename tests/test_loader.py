@@ -1,0 +1,181 @@
+"""Weight loader parity: save tiny random HF models with transformers (torch),
+load them with our safetensors loader, and compare logits numerically.
+
+This is the strongest offline check of RoPE/GQA/norm/softcap conventions:
+if any convention diverges, logits diverge.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from introspective_awareness_tpu.models.config import config_from_hf
+from introspective_awareness_tpu.models.loader import load_params
+from introspective_awareness_tpu.models.transformer import forward, make_positions
+
+
+def _save_hf_model(tmp_path, hf_model):
+    hf_model.save_pretrained(tmp_path, safe_serialization=True)
+    return tmp_path
+
+
+def _compare_logits(tmp_path, hf_model, hf_config_dict, atol=2e-3):
+    cfg = config_from_hf(hf_config_dict)
+    params = load_params(tmp_path, cfg, dtype=jnp.float32)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(4, hf_config_dict["vocab_size"], (2, 12)).astype(np.int32)
+
+    hf_model.eval()
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+
+    mask = jnp.ones(ids.shape, jnp.int32)
+    out = forward(
+        params, cfg, jnp.asarray(ids), mask, make_positions(mask), logits_mode="all"
+    )
+    got = np.asarray(out.logits, np.float32)
+
+    # Compare log-softmax (absolute logits may differ by a constant shift).
+    def lsm(x):
+        x = x - x.max(axis=-1, keepdims=True)
+        return x - np.log(np.exp(x).sum(axis=-1, keepdims=True))
+
+    np.testing.assert_allclose(lsm(got), lsm(ref), atol=atol, rtol=0)
+
+
+def test_llama_parity(tmp_path):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=False,
+        max_position_embeddings=256,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    _save_hf_model(tmp_path, model)
+    _compare_logits(tmp_path, model, json.load(open(tmp_path / "config.json")))
+
+
+def test_llama_rope_scaling_parity(tmp_path):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 64,
+        },
+        max_position_embeddings=256, tie_word_embeddings=True,
+    )
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    _save_hf_model(tmp_path, model)
+    _compare_logits(tmp_path, model, json.load(open(tmp_path / "config.json")))
+
+
+def test_qwen2_parity(tmp_path):
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2,
+        rms_norm_eps=1e-6, rope_theta=1e6, tie_word_embeddings=False,
+        max_position_embeddings=256,
+    )
+    torch.manual_seed(2)
+    model = transformers.Qwen2ForCausalLM(hf_cfg)
+    _save_hf_model(tmp_path, model)
+    _compare_logits(tmp_path, model, json.load(open(tmp_path / "config.json")))
+
+
+def test_gemma2_parity(tmp_path):
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        query_pre_attn_scalar=16, attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0, sliding_window=8,
+        max_position_embeddings=256,
+    )
+    torch.manual_seed(3)
+    model = transformers.Gemma2ForCausalLM(hf_cfg)
+    _save_hf_model(tmp_path, model)
+    _compare_logits(tmp_path, model, json.load(open(tmp_path / "config.json")))
+
+
+def test_qwen3_parity(tmp_path):
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    torch.manual_seed(4)
+    model = transformers.Qwen3ForCausalLM(hf_cfg)
+    _save_hf_model(tmp_path, model)
+    _compare_logits(tmp_path, model, json.load(open(tmp_path / "config.json")))
+
+
+def test_qwen3_moe_parity(tmp_path):
+    hf_cfg = transformers.Qwen3MoeConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=96, moe_intermediate_size=32,
+        num_experts=4, num_experts_per_tok=2, decoder_sparse_step=1,
+        norm_topk_prob=True, max_position_embeddings=256,
+        mlp_only_layers=[],
+    )
+    torch.manual_seed(5)
+    model = transformers.Qwen3MoeForCausalLM(hf_cfg)
+    _save_hf_model(tmp_path, model)
+    _compare_logits(tmp_path, model, json.load(open(tmp_path / "config.json")))
+
+
+def test_sharded_load_matches_unsharded(tmp_path, mesh8):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=256,
+    )
+    torch.manual_seed(6)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    _save_hf_model(tmp_path, model)
+    hf_dict = json.load(open(tmp_path / "config.json"))
+    cfg = config_from_hf(hf_dict)
+
+    plain = load_params(tmp_path, cfg, dtype=jnp.float32)
+    sharded = load_params(tmp_path, cfg, mesh=mesh8, dtype=jnp.float32)
+
+    # TP sharding actually happened: wq is split over the model axis.
+    shard_shapes = {
+        s.data.shape for s in sharded["layers"]["wq"].addressable_shards
+    }
+    full = plain["layers"]["wq"].shape
+    assert all(s[-1] < full[-1] for s in shard_shapes)
+
+    ids = jnp.asarray(np.arange(24).reshape(2, 12) % 128, jnp.int32)
+    mask = jnp.ones(ids.shape, jnp.int32)
+    out_plain = forward(plain, cfg, ids, mask, make_positions(mask), logits_mode="last")
+    out_sharded = forward(sharded, cfg, ids, mask, make_positions(mask), logits_mode="last")
+    np.testing.assert_allclose(
+        np.asarray(out_plain.logits), np.asarray(out_sharded.logits),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_gemma3_parity(tmp_path):
+    hf_cfg = transformers.Gemma3TextConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=6,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        query_pre_attn_scalar=16, sliding_window=8, sliding_window_pattern=6,
+        rope_theta=1_000_000.0, rope_local_base_freq=10_000.0,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        max_position_embeddings=256,
+    )
+    torch.manual_seed(7)
+    model = transformers.Gemma3ForCausalLM(hf_cfg)
+    _save_hf_model(tmp_path, model)
+    hf_dict = json.load(open(tmp_path / "config.json"))
+    _compare_logits(tmp_path, model, hf_dict)
