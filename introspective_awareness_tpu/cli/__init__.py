@@ -1,0 +1,13 @@
+"""CLI / sweep orchestration (L5).
+
+``python -m introspective_awareness_tpu.cli --models llama_8b --layer-sweep
+0.4 0.5 0.6 0.7 0.8 --strength-sweep 1 2 4 8`` — the counterpart of the
+reference's ``detect_injected_thoughts.py`` entry point: model x layer x
+strength x concept sweep with artifact-based resume, judge re-evaluation,
+plots, transcripts, and debug dumps.
+"""
+
+from introspective_awareness_tpu.cli.args import build_parser, parse_args
+from introspective_awareness_tpu.cli.sweep import main, run_sweep
+
+__all__ = ["build_parser", "parse_args", "main", "run_sweep"]

@@ -1,0 +1,94 @@
+"""Argument surface: the reference's knobs plus TPU mesh flags.
+
+Mirrors ``detect_injected_thoughts.py:102-125`` flag-for-flag (the grid these
+knobs define IS the experiment), then adds mesh/sharding and judge-backend
+options the TPU runtime needs. Experiment defaults from
+``detect_injected_thoughts.py:54-78``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from introspective_awareness_tpu.vectors.data import DEFAULT_TEST_CONCEPTS
+
+DEFAULT_N_BASELINE = 100
+DEFAULT_LAYER_FRACTION = 0.7
+DEFAULT_LAYER_SWEEP = [0.4, 0.5, 0.6, 0.7, 0.8]
+DEFAULT_STRENGTH = 8.0
+DEFAULT_STRENGTH_SWEEP = [1.0, 2.0, 4.0, 8.0]
+DEFAULT_N_TRIALS = 30
+DEFAULT_TEMPERATURE = 1.0
+DEFAULT_MAX_TOKENS = 100
+DEFAULT_BATCH_SIZE = 256
+DEFAULT_OUTPUT_DIR = "introspective-awareness"
+DEFAULT_MODEL = "llama_8b"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="introspective_awareness_tpu",
+        description="Injected-thoughts introspection eval (TPU-native)",
+    )
+    parser.add_argument("-m", "--models", type=str, nargs="+", default=[DEFAULT_MODEL],
+                        help="Model short name(s), checkpoint dirs, 'tiny[:seed]' "
+                             "random smoke models, or 'all' to rescan the output dir")
+    parser.add_argument("-c", "--concepts", type=str, nargs="+",
+                        default=DEFAULT_TEST_CONCEPTS, help="Concept words to test")
+    parser.add_argument("-nb", "--n-baseline", type=int, default=DEFAULT_N_BASELINE,
+                        help="Number of baseline words for vector extraction")
+    parser.add_argument("-lf", "--layer-fraction", type=float, default=None,
+                        help="Single layer fraction (if not sweeping)")
+    parser.add_argument("-ls", "--layer-sweep", type=float, nargs="+", default=None,
+                        help="Sweep over layer fractions (e.g. 0.4 0.5 0.6 0.7 0.8)")
+    parser.add_argument("-s", "--strength", type=float, default=None,
+                        help="Single steering strength (if not sweeping)")
+    parser.add_argument("-ss", "--strength-sweep", type=float, nargs="+", default=None,
+                        help="Sweep over strengths (e.g. 1 2 4 8)")
+    parser.add_argument("-nt", "--n-trials", type=int, default=DEFAULT_N_TRIALS,
+                        help="Trials per concept (split injection/control)")
+    parser.add_argument("-t", "--temperature", type=float, default=DEFAULT_TEMPERATURE)
+    parser.add_argument("-mt", "--max-tokens", type=int, default=DEFAULT_MAX_TOKENS)
+    parser.add_argument("-bs", "--batch-size", type=int, default=DEFAULT_BATCH_SIZE)
+    parser.add_argument("-od", "--output-dir", type=str, default=DEFAULT_OUTPUT_DIR)
+    parser.add_argument("-dt", "--dtype", type=str, default="bfloat16",
+                        choices=["bfloat16", "float16", "float32"])
+    parser.add_argument("-em", "--extraction-method", type=str, default="baseline",
+                        choices=["baseline", "simple", "no_baseline"])
+    parser.add_argument("-nlj", "--no-llm-judge", action="store_true",
+                        help="Disable LLM judge (keyword metrics only)")
+    parser.add_argument("-nsv", "--no-save-vectors", action="store_true")
+    parser.add_argument("-ow", "--overwrite", action="store_true",
+                        help="Overwrite existing results (default: resume)")
+    parser.add_argument("-rej", "--reevaluate-judge", action="store_true",
+                        help="Re-grade existing results without regenerating")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="Base RNG seed for sampling")
+    # TPU mesh / judge backend (no reference counterpart)
+    parser.add_argument("--dp", type=int, default=None, help="Data-parallel axis size")
+    parser.add_argument("--tp", type=int, default=1, help="Tensor-parallel axis size")
+    parser.add_argument("--ep", type=int, default=1, help="Expert-parallel axis size")
+    parser.add_argument("--sp", type=int, default=1, help="Sequence-parallel axis size")
+    parser.add_argument("--judge-backend", type=str, default="openai",
+                        choices=["openai", "on-device", "none"],
+                        help="openai = API judge (reference behavior); "
+                             "on-device = co-resident JAX grader; none = keyword only")
+    parser.add_argument("--judge-model", type=str, default="gpt-4.1-nano",
+                        help="Judge model: API name, checkpoint dir, or tiny[:seed]")
+    return parser
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    args = build_parser().parse_args(argv)
+    if args.layer_sweep is None:
+        args.layer_sweep = (
+            [args.layer_fraction] if args.layer_fraction is not None
+            else DEFAULT_LAYER_SWEEP
+        )
+    if args.strength_sweep is None:
+        args.strength_sweep = (
+            [args.strength] if args.strength is not None else DEFAULT_STRENGTH_SWEEP
+        )
+    if args.no_llm_judge:
+        args.judge_backend = "none"
+    return args

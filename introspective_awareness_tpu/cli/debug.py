@@ -1,0 +1,106 @@
+"""Debug artifact dumps (reference detect_injected_thoughts.py:186-296,
+:1519-1633, :2169-2216): model config, a token-by-token extraction sample,
+per-concept vector statistics across swept layers, and a full sample trial
+with its steering start position.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from introspective_awareness_tpu.models.registry import get_layer_at_fraction
+from introspective_awareness_tpu.protocol.prompts import render_trial_prompt
+from introspective_awareness_tpu.vectors import format_concept_prompt
+
+
+def write_debug_dumps(out_base: Path, runner, args, all_results: dict) -> None:
+    debug_dir = Path(out_base) / "debug"
+    debug_dir.mkdir(parents=True, exist_ok=True)
+
+    # model_config.txt
+    cfg_lines = [f"model: {runner.model_name}", f"n_layers: {runner.n_layers}"]
+    for field in (
+        "vocab_size", "hidden_size", "n_heads", "n_kv_heads", "head_dim",
+        "mlp_hidden", "rope_theta", "sliding_window", "n_experts",
+    ):
+        cfg_lines.append(f"{field}: {getattr(runner.cfg, field)}")
+    (debug_dir / "model_config.txt").write_text("\n".join(cfg_lines) + "\n")
+
+    # concept_extraction_sample.txt — first concept's prompt, token dump
+    concept = args.concepts[0]
+    prompt = format_concept_prompt(runner, concept)
+    ids = runner.tokenizer.encode(prompt)
+    sample = [
+        f"concept: {concept}",
+        f"extraction method: {args.extraction_method}",
+        "",
+        "PROMPT:",
+        prompt,
+        "",
+        f"TOKENS ({len(ids)}):",
+    ]
+    for i, t in enumerate(ids[:64]):
+        sample.append(f"  [{i:3d}] {t:6d} {runner.tokenizer.decode([t])!r}")
+    if len(ids) > 64:
+        sample.append(f"  ... {len(ids) - 64} more")
+    (debug_dir / "concept_extraction_sample.txt").write_text("\n".join(sample) + "\n")
+
+    # vector_statistics.txt — per-concept norms per swept layer, from artifacts
+    from introspective_awareness_tpu.metrics import vector_path
+    from introspective_awareness_tpu.vectors import load_concept_vector
+
+    stats = ["per-concept vector statistics (norm / mean / std)", ""]
+    for lf in args.layer_sweep:
+        stats.append(
+            f"layer fraction {lf:.2f} "
+            f"(layer {get_layer_at_fraction(runner.n_layers, lf)}):"
+        )
+        for concept in args.concepts:
+            p = vector_path(args.output_dir, runner.model_name, lf, concept)
+            if not p.exists():
+                continue
+            vec, _ = load_concept_vector(p)
+            stats.append(
+                f"  {concept:>16}: norm={np.linalg.norm(vec):9.4f} "
+                f"mean={vec.mean():+9.5f} std={vec.std():9.5f}"
+            )
+    (debug_dir / "vector_statistics.txt").write_text("\n".join(stats) + "\n")
+
+    # introspection_test_sample.txt — first injection trial of the first cell
+    if all_results:
+        (lf, strength), data = sorted(all_results.items())[0]
+        first = next(
+            (r for r in data.get("results", []) if r.get("trial_type") == "injection"),
+            None,
+        )
+        if first is not None:
+            rendered, start = render_trial_prompt(
+                runner.tokenizer, runner.model_name, first["trial"], "injection"
+            )
+            ids = runner.tokenizer.encode(rendered)
+            lines = [
+                "INTROSPECTION TEST SAMPLE",
+                "=" * 80,
+                f"config: layer fraction {lf:.2f} (layer {first.get('layer')}), "
+                f"strength {strength}",
+                f"concept: {first.get('concept')}   trial: {first.get('trial')}",
+                "",
+                "FORMATTED PROMPT:",
+                rendered,
+                "",
+                f"total tokens: {len(ids)}",
+                f"token ids (first 20): {ids[:20]}",
+                f"steering start position: {start} "
+                "(token before 'Trial N'; steering continues through all "
+                "generated tokens)",
+                "",
+                "RESPONSE:",
+                str(first.get("response")),
+                "",
+                f"keyword detected: {first.get('detected')}",
+            ]
+            (debug_dir / "introspection_test_sample.txt").write_text(
+                "\n".join(lines) + "\n"
+            )

@@ -1,0 +1,207 @@
+"""Sweep and cross-model plots (reference detect_injected_thoughts.py:560-1077).
+
+Same figures, own implementation: per-concept layer x strength heatmaps,
+sweep line plots with binomial standard-error bars, best-config summary, and
+cross-model key-metric bars + heatmaps read back from results.json artifacts.
+Matplotlib uses the Agg backend (headless TPU hosts).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Sequence
+
+import numpy as np
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def _rates_by_concept(all_results: dict, concepts: Sequence[str]):
+    """{concept: {(lf, s): (rate, se)}} keyword-detection rates per cell."""
+    rates: dict = {c: {} for c in concepts}
+    for (lf, s), data in all_results.items():
+        per_concept: dict = {c: [] for c in concepts}
+        for r in data.get("results", []):
+            if r["concept"] in per_concept:
+                per_concept[r["concept"]].append(bool(r.get("detected")))
+        for c, dets in per_concept.items():
+            if dets:
+                n = len(dets)
+                p = sum(dets) / n
+                rates[c][(lf, s)] = (p, float(np.sqrt(p * (1 - p) / n)))
+    return rates
+
+
+def create_sweep_plots(
+    all_results: dict,
+    concepts: Sequence[str],
+    layer_fractions: Sequence[float],
+    strengths: Sequence[float],
+    output_dir: Path,
+) -> None:
+    if not all_results:
+        return
+    plt = _plt()
+    plots_dir = Path(output_dir) / "plots"
+    individual = plots_dir / "individual"
+    individual.mkdir(parents=True, exist_ok=True)
+
+    rates = _rates_by_concept(all_results, concepts)
+
+    # Per-concept layer x strength heatmaps
+    for concept in concepts:
+        grid = np.zeros((len(layer_fractions), len(strengths)))
+        for i, lf in enumerate(layer_fractions):
+            for j, s in enumerate(strengths):
+                grid[i, j] = rates[concept].get((lf, s), (0.0, 0.0))[0]
+        fig, ax = plt.subplots(figsize=(8, 6))
+        im = ax.imshow(grid, cmap="RdYlGn", vmin=0, vmax=1, aspect="auto")
+        ax.set_xticks(range(len(strengths)), [f"{s:g}" for s in strengths])
+        ax.set_yticks(range(len(layer_fractions)), [f"{lf:.2f}" for lf in layer_fractions])
+        ax.set_xlabel("Steering strength")
+        ax.set_ylabel("Layer fraction")
+        ax.set_title(f"Detection rate: {concept}")
+        for i in range(len(layer_fractions)):
+            for j in range(len(strengths)):
+                ax.text(j, i, f"{grid[i, j]:.2f}", ha="center", va="center", fontsize=9)
+        fig.colorbar(im, ax=ax)
+        fig.tight_layout()
+        fig.savefig(individual / f"heatmap_{concept}.png", dpi=100)
+        plt.close(fig)
+
+    # Mean-over-concepts judge-metric line plots with binomial SE bars
+    def metric_grid(key: str) -> np.ndarray:
+        grid = np.full((len(layer_fractions), len(strengths)), np.nan)
+        for i, lf in enumerate(layer_fractions):
+            for j, s in enumerate(strengths):
+                val = all_results.get((lf, s), {}).get(key)
+                if val is not None:
+                    grid[i, j] = val
+        return grid
+
+    for key, label in [
+        ("detection_hit_rate", "Detection hit rate"),
+        ("combined_detection_and_identification_rate", "Introspection rate"),
+    ]:
+        grid = metric_grid(key)
+        # Both plotted rates are conditioned on injection trials, so the
+        # binomial SE denominator is n_injection — not the cell's full
+        # (injection + control + forced) result count.
+        n_inj = max(
+            (d.get("n_injection") or 0 for d in all_results.values()), default=0
+        )
+        fig, ax = plt.subplots(figsize=(8, 6))
+        for j, s in enumerate(strengths):
+            ys = grid[:, j]
+            se = np.sqrt(np.clip(ys * (1 - ys), 0, None) / max(n_inj, 1))
+            ax.errorbar(layer_fractions, ys, yerr=se, marker="o", capsize=3,
+                        label=f"strength {s:g}")
+        ax.set_xlabel("Layer fraction")
+        ax.set_ylabel(label)
+        ax.set_ylim(-0.05, 1.05)
+        ax.legend()
+        ax.set_title(f"{label} by layer and strength")
+        fig.tight_layout()
+        fig.savefig(plots_dir / f"sweep_{key}.png", dpi=100)
+        plt.close(fig)
+
+
+def _load_model_cells(base_output_dir: Path, model_name: str) -> dict:
+    """{(lf, s): metrics} from a model's saved results.json artifacts."""
+    model_dir = Path(base_output_dir) / model_name.replace("/", "_")
+    cells = {}
+    for cell in sorted(model_dir.glob("layer_*_strength_*")):
+        f = cell / "results.json"
+        if not f.exists():
+            continue
+        parts = cell.name.split("_")  # layer_{lf}_strength_{s}
+        lf, s = float(parts[1]), float(parts[3])
+        with open(f) as fh:
+            cells[(lf, s)] = json.load(fh).get("metrics", {})
+    return cells
+
+
+def best_config(cells: dict) -> tuple | None:
+    """Cell with the highest introspection rate."""
+    best = None
+    for key, m in cells.items():
+        comb = m.get("combined_detection_and_identification_rate") or 0
+        if best is None or comb > best[1]:
+            best = (key, comb, m)
+    return best
+
+
+def create_cross_model_comparison_plots(
+    base_output_dir: Path, models: Sequence[str]
+) -> None:
+    """Grouped key-metric bars at each model's best config, plus per-model
+    heatmaps (reference :771-1077)."""
+    plt = _plt()
+    shared = Path(base_output_dir) / "shared"
+    shared.mkdir(parents=True, exist_ok=True)
+
+    summary = {}
+    for model in models:
+        cells = _load_model_cells(base_output_dir, model)
+        if not cells:
+            continue
+        best = best_config(cells)
+        if best:
+            summary[model] = best
+
+    if not summary:
+        return
+
+    names = sorted(summary, key=lambda m: -summary[m][1])
+    keys = [
+        ("detection_accuracy", "Detection accuracy"),
+        ("detection_false_alarm_rate", "False positive rate"),
+        ("combined_detection_and_identification_rate", "Introspection rate"),
+    ]
+    x = np.arange(len(names))
+    width = 0.8 / len(keys)
+    fig, ax = plt.subplots(figsize=(max(8, 1.4 * len(names)), 6))
+    for k, (key, label) in enumerate(keys):
+        vals = [summary[m][2].get(key) or 0 for m in names]
+        ax.bar(x + (k - 1) * width, vals, width, label=label)
+    ax.set_xticks(x)
+    labels = [
+        f"{m}\nL{summary[m][0][0]:.2f} S{summary[m][0][1]:g}" for m in names
+    ]
+    ax.set_xticklabels(labels, fontsize=8)
+    ax.set_ylim(0, 1.05)
+    ax.legend()
+    ax.set_title("Key metrics at each model's best configuration")
+    fig.tight_layout()
+    fig.savefig(shared / "model_comparison_key_metrics.png", dpi=100)
+    plt.close(fig)
+
+    # Per-model introspection-rate heatmaps in one figure
+    fig, axes = plt.subplots(
+        1, len(names), figsize=(4 * len(names), 4), squeeze=False
+    )
+    for ax, model in zip(axes[0], names):
+        cells = _load_model_cells(base_output_dir, model)
+        lfs = sorted({k[0] for k in cells})
+        sts = sorted({k[1] for k in cells})
+        grid = np.zeros((len(lfs), len(sts)))
+        for (lf, s), m in cells.items():
+            grid[lfs.index(lf), sts.index(s)] = (
+                m.get("combined_detection_and_identification_rate") or 0
+            )
+        im = ax.imshow(grid, cmap="RdYlGn", vmin=0, vmax=1, aspect="auto")
+        ax.set_xticks(range(len(sts)), [f"{s:g}" for s in sts], fontsize=8)
+        ax.set_yticks(range(len(lfs)), [f"{lf:.2f}" for lf in lfs], fontsize=8)
+        ax.set_title(model, fontsize=10)
+    fig.suptitle("Introspection rate by layer x strength")
+    fig.tight_layout()
+    fig.savefig(shared / "model_comparison_heatmaps.png", dpi=100)
+    plt.close(fig)

@@ -1,0 +1,442 @@
+"""Sweep orchestrator: the main experiment loop.
+
+Reference ``main`` (detect_injected_thoughts.py:1305-2293), restructured
+around the TPU runtime's strengths:
+
+- Vectors for EVERY layer fraction come from one capture pass
+  (``extract_concept_vectors_all_layers``) instead of one extraction per
+  fraction.
+- All (layer, strength) cells and all three trial types reuse one compiled
+  generate executable — layer index and strength are runtime operands.
+- Resume is artifact-based: a cell is done iff its ``results.json`` exists
+  (reference :1654-1656); ``--reevaluate-judge`` re-grades saved responses
+  without regenerating (:1658-1738); ``--models all`` rescans the output dir
+  (:1341-1357).
+- Each model run writes a ``run_manifest.json`` (mesh shape, device/chip
+  info, phase timings) — the machine-readable observability artifact
+  (SURVEY.md §5.5 plan).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from introspective_awareness_tpu.metrics import (
+    compute_detection_and_identification_metrics,
+    config_dir,
+    results_to_csv,
+    save_evaluation_results,
+    vector_path,
+)
+from introspective_awareness_tpu.models.registry import get_layer_at_fraction
+from introspective_awareness_tpu.protocol.prompts import (
+    FORCED_TRIAL_QUESTION,
+    TRIAL_QUESTION,
+)
+from introspective_awareness_tpu.protocol.trials import run_trial_pass
+from introspective_awareness_tpu.vectors import (
+    extract_concept_vectors_all_layers,
+    get_baseline_words,
+    save_concept_vector,
+)
+
+
+def _keyword_metrics(results: list[dict]) -> dict:
+    """Judge-free fallback metrics (reference detect_injected_thoughts.py:2094-2122)."""
+    injection = [r for r in results if r["injected"] and r["trial_type"] == "injection"]
+    control = [r for r in results if not r["injected"] and r["trial_type"] == "control"]
+    forced = [r for r in results if r["trial_type"] == "forced_injection"]
+    return {
+        "detection_hit_rate": (
+            sum(r["detected"] for r in injection) / len(injection) if injection else 0
+        ),
+        "detection_false_alarm_rate": (
+            sum(r["detected"] for r in control) / len(control) if control else 0
+        ),
+        "detection_accuracy": 0,
+        "identification_accuracy_given_claim": 0,
+        "combined_detection_and_identification_rate": 0,
+        "forced_identification_accuracy": (
+            sum(r["detected"] for r in forced) / len(forced) if forced else 0
+        ),
+    }
+
+
+def _original_prompts(results: list[dict]) -> list[str]:
+    """Reconstruct the trial question per saved result (reference :1665-1676)."""
+    prompts = []
+    for r in results:
+        n = r.get("trial", 1)
+        if r.get("trial_type", "injection") == "forced_injection":
+            prompts.append(FORCED_TRIAL_QUESTION.format(n=n))
+        else:
+            prompts.append(TRIAL_QUESTION.format(n=n))
+    return prompts
+
+
+def _build_judge(args, mesh, rules):
+    """Judge per --judge-backend; None means keyword metrics only."""
+    from introspective_awareness_tpu.judge import (
+        LLMJudge,
+        OnDeviceJudgeClient,
+        OpenAIJudgeClient,
+    )
+
+    if args.judge_backend == "none":
+        return None
+    if args.judge_backend == "on-device":
+        grader = load_subject(args.judge_model, args, mesh, rules)
+        return LLMJudge(client=OnDeviceJudgeClient(grader, max_tokens=500))
+    try:
+        return LLMJudge(client=OpenAIJudgeClient(model=args.judge_model))
+    except (ValueError, ImportError) as e:
+        print(f"LLM judge unavailable ({e}); falling back to keyword metrics")
+        return None
+
+
+def load_subject(name: str, args, mesh, rules):
+    """Model name/path → ModelRunner.
+
+    - ``tiny`` / ``tiny:<seed>``: random-init smoke model with the byte
+      tokenizer (offline CI / BASELINE.json CPU smoke config)
+    - a directory with config.json: local checkpoint via the loader
+    - registry short name / HF repo id: resolved then loaded from the local
+      HF cache path (network download is out of scope for the runtime)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from introspective_awareness_tpu.models.config import tiny_config
+    from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+    from introspective_awareness_tpu.models.transformer import (
+        init_params,
+        param_logical_axes,
+    )
+    from introspective_awareness_tpu.parallel import sharding as shax
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    dtype = dict(bfloat16=jnp.bfloat16, float16=jnp.float16, float32=jnp.float32)[
+        args.dtype
+    ]
+
+    if name.startswith("tiny"):
+        seed = int(name.split(":", 1)[1]) if ":" in name else 0
+        cfg = tiny_config(n_layers=4)
+        params = init_params(cfg, jax.random.key(seed), dtype=jnp.float32)
+        if mesh is not None:
+            params = shax.shard_params(
+                params, param_logical_axes(cfg), mesh, rules
+            )
+        return ModelRunner(
+            params, cfg, ByteTokenizer(), model_name=name, mesh=mesh, rules=rules,
+            seed=args.seed,
+        )
+
+    from introspective_awareness_tpu.models.loader import load_model
+    from introspective_awareness_tpu.models.registry import resolve_model_name
+
+    path = Path(name)
+    if not (path / "config.json").exists():
+        path = Path(resolve_model_name(name))
+        if not (path / "config.json").exists():
+            raise FileNotFoundError(
+                f"{name!r} is not a checkpoint directory; download the HF repo "
+                f"({path}) and pass its local path"
+            )
+    return load_model(
+        path, mesh=mesh, rules=rules, dtype=dtype, model_name=name, seed=args.seed
+    )
+
+
+def run_sweep(args, runner, judge, model_name: str) -> dict:
+    """All (layer, strength) cells for one loaded model. Returns
+    ``{(layer_frac, strength): {"results": ..., <metrics>}}`` for plotting."""
+    out_base = Path(args.output_dir) / model_name.replace("/", "_")
+    layer_fractions = list(args.layer_sweep)
+    strengths = list(args.strength_sweep)
+    timings: dict[str, float] = {}
+
+    # ---- vectors for every swept layer, one capture pass ------------------
+    t0 = time.perf_counter()
+    table = extract_concept_vectors_all_layers(
+        runner,
+        args.concepts,
+        get_baseline_words(args.n_baseline),
+        extraction_method=args.extraction_method,
+    )
+    vectors_by_fraction = {
+        lf: table[get_layer_at_fraction(runner.n_layers, lf)]
+        for lf in layer_fractions
+    }
+    timings["extraction_s"] = round(time.perf_counter() - t0, 3)
+
+    if not args.no_save_vectors:
+        for lf, vecs in vectors_by_fraction.items():
+            for concept, vec in vecs.items():
+                save_concept_vector(
+                    vec,
+                    vector_path(args.output_dir, model_name, lf, concept),
+                    metadata={
+                        "concept": concept,
+                        "layer_fraction": lf,
+                        "layer_idx": get_layer_at_fraction(runner.n_layers, lf),
+                        "model": model_name,
+                        "extraction_method": args.extraction_method,
+                    },
+                )
+
+    n_injection = args.n_trials // 2
+    n_control = args.n_trials - n_injection
+
+    all_results: dict = {}
+    t_gen = 0.0
+    for ci, lf in enumerate(layer_fractions):
+        layer_idx = get_layer_at_fraction(runner.n_layers, lf)
+        for strength in strengths:
+            cell_dir = config_dir(args.output_dir, model_name, lf, strength)
+            results_file = cell_dir / "results.json"
+
+            if results_file.exists() and not args.overwrite:
+                with open(results_file) as f:
+                    saved = json.load(f)
+                results = saved.get("results", [])
+                if args.reevaluate_judge and judge is not None:
+                    # _cell_metrics runs the (single) judge pass itself.
+                    metrics = _cell_metrics(results, judge, args, lf, layer_idx, strength)
+                    _save_cell(results, metrics, cell_dir)
+                    print(f"  re-judged L={lf:.2f} S={strength}")
+                else:
+                    metrics = saved.get("metrics", {})
+                    print(f"  skip L={lf:.2f} S={strength} (results.json exists)")
+                all_results[(lf, strength)] = {"results": results, **metrics}
+                continue
+
+            # ---- generate: 3 passes on one executable ---------------------
+            t0 = time.perf_counter()
+            vectors = vectors_by_fraction[lf]
+            tasks_inj = [(c, t) for c in args.concepts for t in range(1, n_injection + 1)]
+            tasks_ctl = [(c, t) for c in args.concepts for t in range(1, n_control + 1)]
+            # Forced trials numbered after the spontaneous block
+            # (reference :1986 actual_trial_num = n_injection + n_control + t).
+            tasks_fcd = [
+                (c, args.n_trials + t)
+                for c in args.concepts
+                for t in range(1, n_injection + 1)
+            ]
+            common = dict(
+                vectors=vectors, layer_idx=layer_idx, strength=strength,
+                max_new_tokens=args.max_tokens, temperature=args.temperature,
+                layer_fraction=lf, batch_size=args.batch_size, seed=args.seed + ci,
+            )
+            results = run_trial_pass(runner, "injection", tasks_inj, **common)
+            results += run_trial_pass(runner, "control", tasks_ctl, **common)
+            results += run_trial_pass(runner, "forced_injection", tasks_fcd, **common)
+            t_gen += time.perf_counter() - t0
+
+            metrics = _cell_metrics(results, judge, args, lf, layer_idx, strength)
+            _save_cell(results, metrics, cell_dir)
+            all_results[(lf, strength)] = {"results": results, **metrics}
+            print(
+                f"  L={lf:.2f} S={strength}: "
+                f"hit={metrics.get('detection_hit_rate', 0):.2f} "
+                f"fa={metrics.get('detection_false_alarm_rate', 0):.2f} "
+                f"comb={metrics.get('combined_detection_and_identification_rate', 0):.2f}"
+            )
+
+    timings["generation_s"] = round(t_gen, 3)
+    _write_manifest(out_base, args, runner, timings)
+    _write_summary(out_base, all_results, layer_fractions, strengths)
+    return all_results
+
+
+def _cell_metrics(results, judge, args, lf, layer_idx, strength) -> dict:
+    """Judge metrics with keyword fallback (reference :2064-2122)."""
+    if judge is not None:
+        try:
+            evaluated = judge.evaluate_batch(results, _original_prompts(results))
+            results[:] = evaluated
+            metrics = compute_detection_and_identification_metrics(evaluated)
+        except Exception as e:  # noqa: BLE001 - degrade, don't lose responses
+            print(f"  judge failed ({e}); keyword metrics")
+            metrics = _keyword_metrics(results)
+    else:
+        metrics = _keyword_metrics(results)
+    metrics.update({
+        "layer_fraction": lf,
+        "layer_idx": layer_idx,
+        "strength": strength,
+        "temperature": args.temperature,
+        "max_tokens": args.max_tokens,
+    })
+    return metrics
+
+
+def _save_cell(results, metrics, cell_dir: Path) -> None:
+    save_evaluation_results(results, cell_dir / "results.json", metrics)
+    results_to_csv(results, cell_dir / "results.csv")
+
+
+def _write_manifest(out_base: Path, args, runner, timings: dict) -> None:
+    import jax
+
+    out_base.mkdir(parents=True, exist_ok=True)
+    mesh = runner.mesh
+    manifest = {
+        "model": runner.model_name,
+        "n_layers": runner.n_layers,
+        "backend": jax.default_backend(),
+        "n_devices": jax.device_count(),
+        "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)) if mesh else None,
+        "dtype": args.dtype,
+        "batch_size": args.batch_size,
+        "timings": timings,
+    }
+    with open(out_base / "run_manifest.json", "w") as f:
+        json.dump(manifest, f, indent=2)
+
+
+def _write_summary(out_base, all_results, layer_fractions, strengths) -> None:
+    """sweep_summary.txt (reference :2224-2247)."""
+    out_base.mkdir(parents=True, exist_ok=True)
+    lines = [
+        "LAYER x STRENGTH SWEEP SUMMARY",
+        "=" * 80,
+        "",
+        f"Layer Fractions: {layer_fractions}",
+        f"Strengths: {strengths}",
+        "",
+        f"{'Layer':>6} {'Strength':>9} {'Hit':>6} {'FA':>6} {'DetAcc':>7} "
+        f"{'Comb':>6} {'ForcedID':>9}",
+    ]
+    best = None
+    for (lf, s), data in sorted(all_results.items()):
+        comb = data.get("combined_detection_and_identification_rate", 0) or 0
+        lines.append(
+            f"{lf:>6.2f} {s:>9.1f} "
+            f"{data.get('detection_hit_rate', 0) or 0:>6.2f} "
+            f"{data.get('detection_false_alarm_rate', 0) or 0:>6.2f} "
+            f"{data.get('detection_accuracy', 0) or 0:>7.2f} "
+            f"{comb:>6.2f} "
+            f"{data.get('forced_identification_accuracy', 0) or 0:>9.2f}"
+        )
+        if best is None or comb > best[2]:
+            best = (lf, s, comb)
+    if best:
+        lines += ["", f"Best config by introspection rate: "
+                      f"L={best[0]:.2f} S={best[1]} ({best[2]:.2%})"]
+    (out_base / "sweep_summary.txt").write_text("\n".join(lines) + "\n")
+
+
+def _scan_models(output_dir: str) -> list[str]:
+    """--models all: every model dir with at least one results cell
+    (reference :1341-1357). The original (unmangled) model name is recovered
+    from the dir's run_manifest.json so a later load/re-run can resolve the
+    checkpoint; the directory name is only a fallback."""
+    base = Path(output_dir)
+    if not base.exists():
+        return []
+    names = []
+    for d in sorted(base.iterdir()):
+        if not d.is_dir() or d.name == "shared":
+            continue
+        if not list(d.glob("layer_*_strength_*")):
+            continue
+        manifest = d / "run_manifest.json"
+        if manifest.exists():
+            try:
+                names.append(json.loads(manifest.read_text())["model"])
+                continue
+            except (KeyError, json.JSONDecodeError):
+                pass
+        names.append(d.name)
+    return names
+
+
+def _rejudge_cells(args, judge, model_name: str) -> dict:
+    """--reevaluate-judge over a fully-complete sweep: re-grade saved
+    responses without loading the subject model or extracting vectors —
+    grading is text-in/text-out (reference :1400-1502)."""
+    all_results: dict = {}
+    for lf in args.layer_sweep:
+        for strength in args.strength_sweep:
+            cell_dir = config_dir(args.output_dir, model_name, lf, strength)
+            with open(cell_dir / "results.json") as f:
+                saved = json.load(f)
+            results = saved.get("results", [])
+            layer_idx = saved.get("metrics", {}).get("layer_idx", -1)
+            metrics = _cell_metrics(results, judge, args, lf, layer_idx, strength)
+            _save_cell(results, metrics, cell_dir)
+            print(f"  re-judged L={lf:.2f} S={strength}")
+            all_results[(lf, strength)] = {"results": results, **metrics}
+    out_base = Path(args.output_dir) / model_name.replace("/", "_")
+    _write_summary(out_base, all_results, args.layer_sweep, args.strength_sweep)
+    return all_results
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    from introspective_awareness_tpu.cli.args import parse_args
+    from introspective_awareness_tpu.cli.debug import write_debug_dumps
+    from introspective_awareness_tpu.cli.plots import (
+        create_cross_model_comparison_plots,
+        create_sweep_plots,
+    )
+    from introspective_awareness_tpu.cli.transcripts import extract_example_transcripts
+    from introspective_awareness_tpu.parallel import MeshConfig, ShardingRules, build_mesh
+
+    args = parse_args(argv)
+    models = list(args.models)
+    if models == ["all"]:
+        models = _scan_models(args.output_dir)
+        if not models:
+            print(f"no existing model results under {args.output_dir}")
+            return 1
+
+    mesh = build_mesh(MeshConfig(dp=args.dp, tp=args.tp, ep=args.ep, sp=args.sp))
+    rules = ShardingRules()
+    judge = _build_judge(args, mesh, rules)
+
+    for model_name in models:
+        print(f"=== {model_name} ===")
+        out_base = Path(args.output_dir) / model_name.replace("/", "_")
+
+        # Fast path: every cell done and no re-eval → no model load at all
+        # (reference :1372-1506).
+        cells = [
+            config_dir(args.output_dir, model_name, lf, s) / "results.json"
+            for lf in args.layer_sweep for s in args.strength_sweep
+        ]
+        if all(c.exists() for c in cells) and not args.overwrite:
+            if args.reevaluate_judge and judge is not None:
+                # Grading is text-only: no subject model load, no extraction.
+                print("  all cells complete; re-judging without model load")
+                all_results = _rejudge_cells(args, judge, model_name)
+            else:
+                print("  all cells complete; skipping model load")
+                all_results = {}
+                for lf in args.layer_sweep:
+                    for s in args.strength_sweep:
+                        with open(config_dir(args.output_dir, model_name, lf, s) / "results.json") as f:
+                            saved = json.load(f)
+                        all_results[(lf, s)] = {
+                            "results": saved.get("results", []), **saved.get("metrics", {})
+                        }
+        else:
+            runner = load_subject(model_name, args, mesh, rules)
+            all_results = run_sweep(args, runner, judge, model_name)
+            write_debug_dumps(out_base, runner, args, all_results)
+            runner.cleanup()
+
+        create_sweep_plots(
+            all_results, args.concepts, args.layer_sweep, args.strength_sweep, out_base
+        )
+
+    if len(models) > 1:
+        base = Path(args.output_dir)
+        create_cross_model_comparison_plots(base, models)
+        extract_example_transcripts(base, models)
+    return 0

@@ -1,0 +1,107 @@
+"""Example-transcript extraction (reference detect_injected_thoughts.py:1080-1302).
+
+One report across all models, ordered by introspection rate at each model's
+best config; per model one sampled transcript (with judge reasoning) for
+each classification case: false positive, detected-wrong-concept,
+detected-correct-concept.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional, Sequence
+
+from introspective_awareness_tpu.cli.plots import _load_model_cells, best_config
+from introspective_awareness_tpu.metrics import config_dir
+
+
+def _claims(r: dict) -> bool:
+    return (
+        r.get("evaluations", {}).get("claims_detection", {}).get("claims_detection", False)
+    )
+
+
+def _identifies(r: dict) -> bool:
+    return (
+        r.get("evaluations", {})
+        .get("correct_concept_identification", {})
+        .get("correct_identification", False)
+    )
+
+
+def _judge_reasoning(r: dict) -> str:
+    ev = r.get("evaluations", {})
+    claims = ev.get("claims_detection", {}).get("raw_response", "n/a")
+    ident = ev.get("correct_concept_identification", {}).get("raw_response", "n/a")
+    return f"claims_detection: {claims}\n  correct_identification: {ident}"
+
+
+def _pick(results: Sequence[dict], pred) -> Optional[dict]:
+    for r in results:
+        if pred(r):
+            return r
+    return None
+
+
+def extract_example_transcripts(
+    base_output_dir: Path, models: Sequence[str]
+) -> Optional[Path]:
+    entries = []
+    for model in models:
+        cells = _load_model_cells(base_output_dir, model)
+        best = best_config(cells)
+        if best is None:
+            continue
+        (lf, s), comb, metrics = best
+        cell_dir = config_dir(base_output_dir, model, lf, s)
+        with open(cell_dir / "results.json") as f:
+            results = json.load(f).get("results", [])
+
+        cases = {
+            "FALSE POSITIVE (control trial, model claims detection)": _pick(
+                results,
+                lambda r: r.get("trial_type") == "control" and _claims(r),
+            ),
+            "DETECTED, WRONG CONCEPT": _pick(
+                results,
+                lambda r: r.get("trial_type") == "injection"
+                and _claims(r) and not _identifies(r),
+            ),
+            "DETECTED, CORRECT CONCEPT": _pick(
+                results,
+                lambda r: r.get("trial_type") == "injection"
+                and _claims(r) and _identifies(r),
+            ),
+        }
+        entries.append((comb, model, lf, s, metrics, cases))
+
+    if not entries:
+        return None
+
+    lines = ["EXAMPLE TRANSCRIPTS BY MODEL (ordered by introspection rate)", "=" * 80, ""]
+    for comb, model, lf, s, metrics, cases in sorted(entries, reverse=True, key=lambda e: e[0]):
+        lines += [
+            f"MODEL: {model}",
+            f"Best config: layer fraction {lf:.2f}, strength {s:g}",
+            f"Detection accuracy: {metrics.get('detection_accuracy', 0) or 0:.1%}   "
+            f"False positive rate: {metrics.get('detection_false_alarm_rate', 0) or 0:.1%}   "
+            f"Introspection rate: {comb:.1%}",
+            "-" * 80,
+        ]
+        for title, r in cases.items():
+            lines.append(f"\n[{title}]")
+            if r is None:
+                lines.append("  (no example in this configuration)")
+                continue
+            lines += [
+                f"  Concept: {r.get('concept')}   Trial: {r.get('trial')}",
+                f"  Response: {r.get('response')}",
+                f"  Judge reasoning:\n  {_judge_reasoning(r)}",
+            ]
+        lines += ["", "=" * 80, ""]
+
+    out = Path(base_output_dir) / "shared" / "example_transcripts.txt"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text("\n".join(lines))
+    return out

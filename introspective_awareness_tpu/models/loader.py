@@ -147,6 +147,7 @@ def load_params(
     mesh=None,
     rules: ShardingRules | None = None,
     dtype=jnp.bfloat16,
+    reader: CheckpointReader | None = None,
 ) -> dict:
     """Read a checkpoint directory into the stacked-params pytree.
 
@@ -154,7 +155,7 @@ def load_params(
     host peak is one stacked parameter (the layer stack of a single weight),
     freed before the next is read.
     """
-    reader = CheckpointReader(Path(ckpt_dir))
+    reader = reader or CheckpointReader(Path(ckpt_dir))
     rules = rules or ShardingRules()
     axes = param_logical_axes(cfg)
 
@@ -227,14 +228,15 @@ def load_model(
     with open(model_path / "config.json") as f:
         cfg = config_from_hf(json.load(f))
     # HF omits tie_word_embeddings from config.json when it equals the model
-    # class default, so trust the checkpoint: no lm_head tensor ⇒ tied.
-    if not cfg.tie_embeddings:
-        reader = CheckpointReader(model_path)
-        tied = "lm_head.weight" not in reader
-        reader.close()
-        if tied:
-            cfg = dataclasses.replace(cfg, tie_embeddings=True)
-    params = load_params(model_path, cfg, mesh=mesh, rules=rules, dtype=dtype)
+    # class default, so trust the checkpoint: no lm_head tensor ⇒ tied. One
+    # reader serves both the tie check and the weight load (index parsing /
+    # shard enumeration happens once).
+    reader = CheckpointReader(model_path)
+    if not cfg.tie_embeddings and "lm_head.weight" not in reader:
+        cfg = dataclasses.replace(cfg, tie_embeddings=True)
+    params = load_params(
+        model_path, cfg, mesh=mesh, rules=rules, dtype=dtype, reader=reader
+    )
     tokenizer = HFTokenizer(str(model_path))
     return ModelRunner(
         params, cfg, tokenizer,

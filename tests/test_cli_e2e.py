@@ -1,0 +1,228 @@
+"""End-to-end CLI sweep on the tiny model + virtual mesh (SURVEY §7.3 slice).
+
+Covers: vector extraction + saving, three trial passes, keyword metrics
+(judge=none), artifact layout, resume (skip existing cells without model
+load), plots, transcripts, and debug dumps.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from introspective_awareness_tpu.cli.sweep import main
+
+
+def _run(tmp_path, extra=()):
+    argv = [
+        "--models", "tiny",
+        "--concepts", "Dust", "Trees",
+        "--n-baseline", "5",
+        "--layer-sweep", "0.25", "0.75",
+        "--strength-sweep", "2.0", "8.0",
+        "--n-trials", "4",
+        "--max-tokens", "8",
+        "--batch-size", "16",
+        "--temperature", "0.0",
+        "--output-dir", str(tmp_path / "out"),
+        "--dtype", "float32",
+        "--judge-backend", "none",
+        "--dp", "2", "--tp", "4",
+        *extra,
+    ]
+    return main(argv)
+
+
+@pytest.fixture(scope="module")
+def sweep_out(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("sweep")
+    assert _run(tmp_path) == 0
+    return tmp_path / "out"
+
+
+def test_artifact_layout(sweep_out):
+    model_dir = sweep_out / "tiny"
+    cells = sorted(p.name for p in model_dir.glob("layer_*_strength_*"))
+    assert cells == [
+        "layer_0.25_strength_2.0", "layer_0.25_strength_8.0",
+        "layer_0.75_strength_2.0", "layer_0.75_strength_8.0",
+    ]
+    for cell in cells:
+        data = json.loads((model_dir / cell / "results.json").read_text())
+        assert data["n_samples"] == 2 * (2 + 2 + 2)  # concepts x (inj+ctl+forced)
+        assert "detection_hit_rate" in data["metrics"]
+        assert (model_dir / cell / "results.csv").exists()
+    # vectors saved per swept fraction
+    assert (model_dir / "vectors" / "layer_0.25" / "Dust.npz").exists()
+    assert (model_dir / "vectors" / "layer_0.75" / "Trees.json").exists()
+    assert (model_dir / "sweep_summary.txt").exists()
+    manifest = json.loads((model_dir / "run_manifest.json").read_text())
+    assert manifest["mesh"] == {"data": 2, "expert": 1, "seq": 1, "model": 4}
+    assert "extraction_s" in manifest["timings"]
+
+
+def test_trial_mix_and_numbering(sweep_out):
+    data = json.loads(
+        (sweep_out / "tiny" / "layer_0.25_strength_2.0" / "results.json").read_text()
+    )
+    by_type = {}
+    for r in data["results"]:
+        by_type.setdefault(r["trial_type"], []).append(r)
+    assert {t: len(v) for t, v in by_type.items()} == {
+        "injection": 4, "control": 4, "forced_injection": 4
+    }
+    # forced trials numbered after the spontaneous block (n_trials=4 -> 5, 6)
+    assert sorted({r["trial"] for r in by_type["forced_injection"]}) == [5, 6]
+    assert all(not r["injected"] for r in by_type["control"])
+
+
+def test_plots_and_debug(sweep_out):
+    plots = sweep_out / "tiny" / "plots"
+    assert (plots / "individual" / "heatmap_Dust.png").exists()
+    assert (plots / "sweep_detection_hit_rate.png").exists()
+    debug = sweep_out / "tiny" / "debug"
+    for f in (
+        "model_config.txt", "concept_extraction_sample.txt",
+        "vector_statistics.txt", "introspection_test_sample.txt",
+    ):
+        assert (debug / f).exists(), f
+    txt = (debug / "introspection_test_sample.txt").read_text()
+    assert "steering start position" in txt.lower()
+
+
+def test_resume_skips_existing(sweep_out, tmp_path, capsys):
+    # Re-running over the same output dir must not regenerate anything:
+    # the all-cells-complete fast path skips the model load entirely.
+    before = {
+        p: p.stat().st_mtime
+        for p in (sweep_out / "tiny").glob("layer_*/results.json")
+    }
+    assert _run(sweep_out.parent) == 0
+    out = capsys.readouterr().out
+    assert "all cells complete; skipping model load" in out
+    after = {
+        p: p.stat().st_mtime
+        for p in (sweep_out / "tiny").glob("layer_*/results.json")
+    }
+    assert before == after
+
+
+def test_single_cell_and_overwrite(tmp_path):
+    argv_base = [
+        "--models", "tiny:3",
+        "--concepts", "Dust",
+        "--n-baseline", "3",
+        "--layer-fraction", "0.5",
+        "--strength", "4.0",
+        "--n-trials", "2",
+        "--max-tokens", "4",
+        "--temperature", "0.0",
+        "--output-dir", str(tmp_path / "out"),
+        "--dtype", "float32",
+        "--no-llm-judge",
+    ]
+    assert main(argv_base) == 0
+    cell = tmp_path / "out" / "tiny:3" / "layer_0.50_strength_4.0"
+    first = (cell / "results.json").stat().st_mtime
+    assert main(argv_base + ["--overwrite"]) == 0
+    assert (cell / "results.json").stat().st_mtime >= first
+
+
+def test_models_all_rescan(sweep_out, capsys):
+    assert main([
+        "--models", "all",
+        "--concepts", "Dust", "Trees",
+        "--layer-sweep", "0.25", "0.75",
+        "--strength-sweep", "2.0", "8.0",
+        "--output-dir", str(sweep_out),
+        "--judge-backend", "none",
+    ]) == 0
+    assert "=== tiny ===" in capsys.readouterr().out
+
+
+def test_models_all_empty_dir(tmp_path):
+    assert main(["--models", "all", "--output-dir", str(tmp_path / "nope")]) == 1
+
+
+def test_cross_model_plots_and_transcripts(tmp_path):
+    from introspective_awareness_tpu.cli.plots import create_cross_model_comparison_plots
+    from introspective_awareness_tpu.cli.transcripts import extract_example_transcripts
+    from introspective_awareness_tpu.metrics import save_evaluation_results
+
+    def fake_cell(model, lf, s, comb):
+        results = [
+            {"concept": "Dust", "trial": 1, "response": "I notice dust",
+             "injected": True, "trial_type": "injection", "detected": True,
+             "evaluations": {
+                 "claims_detection": {"claims_detection": True, "grade": 1,
+                                      "raw_response": "Answer: YES"},
+                 "correct_concept_identification": {
+                     "correct_identification": True, "grade": 1,
+                     "raw_response": "Answer: YES"}}},
+            {"concept": "Dust", "trial": 2, "response": "hmm yes something",
+             "injected": False, "trial_type": "control", "detected": False,
+             "evaluations": {
+                 "claims_detection": {"claims_detection": True, "grade": 1,
+                                      "raw_response": "Answer: YES"},
+                 "correct_concept_identification": {
+                     "correct_identification": False, "grade": 0,
+                     "raw_response": "N/A"}}},
+        ]
+        metrics = {
+            "detection_accuracy": 0.5,
+            "detection_false_alarm_rate": 1.0,
+            "combined_detection_and_identification_rate": comb,
+        }
+        cell = tmp_path / model / f"layer_{lf:.2f}_strength_{s}"
+        save_evaluation_results(results, cell / "results.json", metrics)
+
+    fake_cell("modelA", 0.5, 2.0, 0.8)
+    fake_cell("modelA", 0.7, 4.0, 0.3)
+    fake_cell("modelB", 0.5, 2.0, 0.6)
+
+    create_cross_model_comparison_plots(tmp_path, ["modelA", "modelB"])
+    assert (tmp_path / "shared" / "model_comparison_key_metrics.png").exists()
+    assert (tmp_path / "shared" / "model_comparison_heatmaps.png").exists()
+
+    out = extract_example_transcripts(tmp_path, ["modelA", "modelB"])
+    text = out.read_text()
+    # ordered by introspection rate: modelA (0.8 best cell) before modelB (0.6)
+    assert text.index("MODEL: modelA") < text.index("MODEL: modelB")
+    assert "Best config: layer fraction 0.50, strength 2" in text
+    assert "DETECTED, CORRECT CONCEPT" in text
+    assert "FALSE POSITIVE" in text and "I notice dust" in text
+
+
+def test_reevaluate_judge_without_model_load(sweep_out, capsys, monkeypatch):
+    # Complete sweep + --reevaluate-judge: responses are re-graded without
+    # loading the subject model (grading is text-only).
+    import introspective_awareness_tpu.cli.sweep as sweep_mod
+
+    class YesClient:
+        model_name = "scripted"
+
+        def grade(self, prompts):
+            return ["Answer: YES"] * len(prompts)
+
+    from introspective_awareness_tpu.judge import LLMJudge
+
+    monkeypatch.setattr(
+        sweep_mod, "_build_judge", lambda args, mesh, rules: LLMJudge(client=YesClient())
+    )
+
+    def boom(*a, **k):
+        raise AssertionError("subject model must not be loaded for re-judging")
+
+    monkeypatch.setattr(sweep_mod, "load_subject", boom)
+
+    assert _run(sweep_out.parent, extra=["--reevaluate-judge"]) == 0
+    out = capsys.readouterr().out
+    assert "re-judging without model load" in out
+
+    data = json.loads(
+        (sweep_out / "tiny" / "layer_0.25_strength_2.0" / "results.json").read_text()
+    )
+    # All trials judged YES -> hit rate 1.0, false alarm 1.0
+    assert data["metrics"]["detection_hit_rate"] == 1.0
+    assert data["metrics"]["detection_false_alarm_rate"] == 1.0
+    assert data["results"][0]["evaluations"]["claims_detection"]["claims_detection"]
