@@ -148,11 +148,13 @@ def create_cross_model_comparison_plots(
     shared = Path(base_output_dir) / "shared"
     shared.mkdir(parents=True, exist_ok=True)
 
+    cells_by_model = {
+        m: cells
+        for m in models
+        if (cells := _load_model_cells(base_output_dir, m))
+    }
     summary = {}
-    for model in models:
-        cells = _load_model_cells(base_output_dir, model)
-        if not cells:
-            continue
+    for model, cells in cells_by_model.items():
         best = best_config(cells)
         if best:
             summary[model] = best
@@ -189,7 +191,7 @@ def create_cross_model_comparison_plots(
         1, len(names), figsize=(4 * len(names), 4), squeeze=False
     )
     for ax, model in zip(axes[0], names):
-        cells = _load_model_cells(base_output_dir, model)
+        cells = cells_by_model[model]
         lfs = sorted({k[0] for k in cells})
         sts = sorted({k[1] for k in cells})
         grid = np.zeros((len(lfs), len(sts)))

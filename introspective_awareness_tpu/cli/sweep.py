@@ -52,6 +52,10 @@ def _keyword_metrics(results: list[dict]) -> dict:
     control = [r for r in results if not r["injected"] and r["trial_type"] == "control"]
     forced = [r for r in results if r["trial_type"] == "forced_injection"]
     return {
+        "n_total": len(results),
+        "n_injection": len(injection),
+        "n_control": len(control),
+        "n_forced": len(forced),
         "detection_hit_rate": (
             sum(r["detected"] for r in injection) / len(injection) if injection else 0
         ),

@@ -13,21 +13,11 @@ from pathlib import Path
 from typing import Optional, Sequence
 
 from introspective_awareness_tpu.cli.plots import _load_model_cells, best_config
-from introspective_awareness_tpu.metrics import config_dir
-
-
-def _claims(r: dict) -> bool:
-    return (
-        r.get("evaluations", {}).get("claims_detection", {}).get("claims_detection", False)
-    )
-
-
-def _identifies(r: dict) -> bool:
-    return (
-        r.get("evaluations", {})
-        .get("correct_concept_identification", {})
-        .get("correct_identification", False)
-    )
+from introspective_awareness_tpu.metrics import (
+    claims_detection as _claims,
+    config_dir,
+    identifies_concept as _identifies,
+)
 
 
 def _judge_reasoning(r: dict) -> str:

@@ -6,8 +6,10 @@ comparison and plotting tools read either framework's artifacts.
 """
 
 from introspective_awareness_tpu.metrics.metrics import (
+    claims_detection,
     compute_aggregate_metrics,
     compute_detection_and_identification_metrics,
+    identifies_concept,
 )
 from introspective_awareness_tpu.metrics.persistence import (
     config_dir,
@@ -18,8 +20,10 @@ from introspective_awareness_tpu.metrics.persistence import (
 )
 
 __all__ = [
+    "claims_detection",
     "compute_aggregate_metrics",
     "compute_detection_and_identification_metrics",
+    "identifies_concept",
     "config_dir",
     "load_evaluation_results",
     "results_to_csv",

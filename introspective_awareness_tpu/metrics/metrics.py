@@ -18,7 +18,8 @@ from __future__ import annotations
 from typing import Sequence
 
 
-def _claims(r: dict) -> bool:
+def claims_detection(r: dict) -> bool:
+    """Did the judge score this trial as claiming a detection?"""
     return (
         r.get("evaluations", {})
         .get("claims_detection", {})
@@ -26,12 +27,18 @@ def _claims(r: dict) -> bool:
     )
 
 
-def _identifies(r: dict) -> bool:
+def identifies_concept(r: dict) -> bool:
+    """Did the judge score the concept identification as correct?"""
     return (
         r.get("evaluations", {})
         .get("correct_concept_identification", {})
         .get("correct_identification", False)
     )
+
+
+# module-internal aliases
+_claims = claims_detection
+_identifies = identifies_concept
 
 
 def compute_detection_and_identification_metrics(

@@ -56,6 +56,10 @@ class ModelConfig:
     sliding_window_pattern: int = 2
     norm_scale_plus_one: bool = False  # Gemma RMSNorm multiplies by (1 + w)
     mlp_activation: str = "silu"  # "silu" (llama/qwen) | "gelu_tanh" (gemma)
+    # "xla" = einsum attention (XLA fuses it); "flash" = Pallas online-softmax
+    # kernel for the S>1 paths (prefill / extraction). Decode (S=1) always
+    # uses the einsum path — a 1-row MXU tile gains nothing from the kernel.
+    attn_impl: str = "xla"
     rope_scaling: RopeScaling | None = None
     max_position: int = 8192
     # MoE (0 experts = dense MLP)

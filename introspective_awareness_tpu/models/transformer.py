@@ -414,8 +414,27 @@ def forward(
         else:
             k_att, v_att = k, v
 
-        amask = jnp.where(sliding, allowed_local, allowed) if cfg.sliding_window else allowed
-        attn = _attention(q, k_att, v_att, amask, cfg)
+        use_flash = (
+            cfg.attn_impl == "flash" and S > 1 and (not use_cache or is_prefill)
+        )
+        if use_flash:
+            # Pallas fused attention over the current chunk; causal +
+            # left-padding + per-layer sliding window are position-space
+            # operands (ops/attention.py). Decode and the non-prefill cached
+            # path stay on the einsum over the full cache.
+            from introspective_awareness_tpu.ops.attention import flash_attention
+
+            win = jnp.where(sliding, cfg.sliding_window or 0, 0)
+            attn = flash_attention(
+                q, k, v, positions, positions, attn_mask,
+                scale=cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5,
+                softcap=cfg.attn_logit_softcap,
+                window=win,
+                interpret=jax.default_backend() == "cpu",
+            )
+        else:
+            amask = jnp.where(sliding, allowed_local, allowed) if cfg.sliding_window else allowed
+            attn = _attention(q, k_att, v_att, amask, cfg)
         attn = jnp.einsum("bsq,qh->bsh", attn.reshape(B, S, cfg.q_dim), lp["wo"])
         if cfg.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], cfg.rms_eps, plus1)

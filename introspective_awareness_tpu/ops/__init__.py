@@ -1,0 +1,18 @@
+"""Hand-written TPU kernels (the native-kernel component, SURVEY.md §2.2).
+
+The reference gets fused attention from the prebuilt flash-attn CUDA wheel
+(pyproject.toml:33); here the equivalent is first-party:
+
+- ``flash_attention`` — Pallas (Mosaic) fused attention with online softmax,
+  GQA, Gemma logit softcap, sliding windows, and left-pad masking expressed
+  in position space.
+- ``ring_attention`` — sequence-parallel attention over the mesh ``seq``
+  axis: KV shards rotate around the ring via ``ppermute`` while each step
+  folds its partial attention into a running online-softmax state (SP/CP,
+  SURVEY.md §5.7).
+"""
+
+from introspective_awareness_tpu.ops.attention import flash_attention, xla_attention
+from introspective_awareness_tpu.ops.ring import ring_attention
+
+__all__ = ["flash_attention", "xla_attention", "ring_attention"]

@@ -1,0 +1,209 @@
+"""Pallas flash attention for TPU.
+
+Replaces the reference's flash-attn CUDA wheel (pyproject.toml:33,52-53) with
+a first-party Mosaic kernel. Masking is expressed in POSITION space — each
+query/key carries its RoPE position and each KV slot a validity bit — which
+makes causal + left-padding + sliding-window all simple vector compares
+inside the kernel, identical to the semantics of the model's mask
+construction (models/transformer.py `forward`).
+
+Algorithm: grid over (batch, query head, query block, KV chunk) with the KV
+chunk innermost ("arbitrary" = sequential); the online-softmax state
+(running max, sum, accumulator) lives in VMEM scratch across KV steps, so
+peak VMEM is O(block_q x block_kv + block_q x head_dim) regardless of
+sequence length.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(
+    window_ref, qpos_ref, kpos_ref, kvalid_ref, q_ref, k_ref, v_ref, o_ref,
+    m_scr, l_scr, acc_scr,
+    *, scale: float, softcap: float | None,
+):
+    """One (batch, head, q-block, kv-block) grid step.
+
+    KV chunks are the innermost grid dimension — each step sees ONE
+    [block_kv, D] K/V tile in VMEM (peak VMEM is O(block_q·block_kv +
+    block_q·D) regardless of sequence length). The online-softmax state
+    (m, l, acc) lives in VMEM scratch, which persists across the
+    sequentially-executed grid steps of the same q-block.
+    """
+    t = pl.program_id(3)
+    q = q_ref[0, 0, :, :].astype(jnp.float32)  # [BQ, D]
+    qp = qpos_ref[0, 0, :]  # [BQ] int32
+    # Traced sliding window (<=0 disables): a runtime operand so Gemma's
+    # alternating local/global layers share one compiled kernel.
+    window = window_ref[0]
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    k = k_ref[0, 0, :, :].astype(jnp.float32)  # [BK, D]
+    v = v_ref[0, 0, :, :].astype(jnp.float32)
+    kp = kpos_ref[0, 0, :]  # [BK]
+    valid = kvalid_ref[0, 0, :]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [BQ, BK]
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
+    allowed = (kp[None, :] <= qp[:, None]) & (valid[None, :] != 0)
+    allowed &= (window <= 0) | ((qp[:, None] - kp[None, :]) < window)
+    s = jnp.where(allowed, s, _NEG_INF)
+
+    m = m_scr[:]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    # Multiply by `allowed`, don't rely on exp underflow: on a fully-masked
+    # row m_new is still _NEG_INF, so exp(s - m_new) = exp(0) = 1 for every
+    # masked entry — the explicit mask keeps l at 0 there (row → zeros).
+    p = jnp.exp(s - m_new) * allowed.astype(jnp.float32)
+    alpha = jnp.exp(m - m_new)
+    m_scr[:] = m_new
+    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(t == pl.num_programs(3) - 1)
+    def _finish():
+        # Fully-masked rows (pad queries) have l == 0; emit zeros, not NaN.
+        o = acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0, 0, :, :] = o.astype(o_ref.dtype)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "softcap", "block_q", "block_kv", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,  # [B, S, NH, D]
+    k: jax.Array,  # [B, T, KVH, D]
+    v: jax.Array,  # [B, T, KVH, D]
+    q_positions: jax.Array,  # [B, S] int32 rope/global positions
+    kv_positions: jax.Array,  # [B, T]
+    kv_valid: jax.Array,  # [B, T] bool/int — False for pad or empty slots
+    *,
+    scale: float,
+    softcap: float | None = None,
+    window=None,  # int / traced int32 scalar; None or <=0 disables
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused attention, causal in position space. Returns [B, S, NH, D].
+
+    GQA: query head h reads KV head ``h // (NH // KVH)``. Sequence dims are
+    padded to block multiples internally; padded KV slots are invalidated and
+    padded query rows sliced off. ``window`` is a RUNTIME operand (may vary
+    per call / per scanned layer without recompiling).
+    """
+    B, S, NH, D = q.shape
+    T, KVH = k.shape[1], k.shape[2]
+    groups = NH // KVH
+
+    s_pad = _round_up(S, block_q)
+    t_pad = _round_up(T, block_kv)
+    if s_pad != S:
+        q = jnp.pad(q, ((0, 0), (0, s_pad - S), (0, 0), (0, 0)))
+        q_positions = jnp.pad(q_positions, ((0, 0), (0, s_pad - S)))
+    if t_pad != T:
+        k = jnp.pad(k, ((0, 0), (0, t_pad - T), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, t_pad - T), (0, 0), (0, 0)))
+        kv_positions = jnp.pad(kv_positions, ((0, 0), (0, t_pad - T)))
+        kv_valid = jnp.pad(kv_valid, ((0, 0), (0, t_pad - T)))
+    # Mosaic needs the last two BLOCK dims divisible by (8, 128) or equal to
+    # the full array dims, so Q/K/V go through the kernel as [B, H, S, D]
+    # (block (1, 1, block, D)) and the per-batch 1-D operands as [B, 1, S].
+    kv_valid = kv_valid.astype(jnp.int32)[:, None, :]
+    q_positions = q_positions.astype(jnp.int32)[:, None, :]
+    kv_positions = kv_positions.astype(jnp.int32)[:, None, :]
+    q = q.transpose(0, 2, 1, 3)  # [B, NH, S, D]
+    k = k.transpose(0, 2, 1, 3)  # [B, KVH, T, D]
+    v = v.transpose(0, 2, 1, 3)
+    if window is None:
+        window = 0  # disabled
+    window_arr = jnp.asarray(window, jnp.int32).reshape(1)
+
+    grid = (B, NH, s_pad // block_q, t_pad // block_kv)
+
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, softcap=softcap),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # window (scalar)
+            pl.BlockSpec((1, 1, block_q), lambda b, h, s, t: (b, 0, s)),  # q_positions
+            pl.BlockSpec((1, 1, block_kv), lambda b, h, s, t: (b, 0, t)),  # kv_positions
+            pl.BlockSpec((1, 1, block_kv), lambda b, h, s, t: (b, 0, t)),  # kv_valid
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, s, t: (b, h, s, 0)),  # q
+            pl.BlockSpec(
+                (1, 1, block_kv, D), lambda b, h, s, t: (b, h // groups, t, 0)
+            ),  # k
+            pl.BlockSpec(
+                (1, 1, block_kv, D), lambda b, h, s, t: (b, h // groups, t, 0)
+            ),  # v
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, s, t: (b, h, s, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, NH, s_pad, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, D), jnp.float32),  # accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(window_arr, q_positions, kv_positions, kv_valid, q, k, v)
+    return out.transpose(0, 2, 1, 3)[:, :S]
+
+
+def xla_attention(
+    q, k, v, q_positions, kv_positions, kv_valid,
+    *, scale, softcap=None, window=None,
+) -> jax.Array:
+    """Reference implementation with identical position-space semantics —
+    the fallback path and the kernel's correctness oracle."""
+    B, S, NH, D = q.shape
+    KVH = k.shape[2]
+    groups = NH // KVH
+    qg = q.reshape(B, S, KVH, groups, D)
+    s = jnp.einsum(
+        "bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
+    allowed = (
+        (kv_positions[:, None, :] <= q_positions[:, :, None])
+        & (kv_valid[:, None, :] != 0)
+    )
+    if window is not None:
+        window = jnp.asarray(window, jnp.int32)
+        allowed &= (window <= 0) | (
+            (q_positions[:, :, None] - kv_positions[:, None, :]) < window
+        )
+    s = jnp.where(allowed[:, None, None, :, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", p, v.astype(p.dtype))
+    # Match the kernel's all-masked-row behavior (zeros, not uniform attn).
+    any_allowed = jnp.any(allowed, axis=-1)  # [B, S]
+    out = jnp.where(any_allowed[:, :, None, None, None], out, 0.0)
+    return out.reshape(B, S, NH, D).astype(q.dtype)

@@ -1,0 +1,117 @@
+"""Ring attention: sequence/context parallelism over the mesh ``seq`` axis.
+
+Long-context attention where Q, K, V are sharded along the sequence axis
+across devices (SURVEY.md §5.7 — the reference has no sequence parallelism
+at all). Each device holds its local Q block permanently; K/V shards rotate
+around the ring with ``lax.ppermute`` (ICI neighbor exchange), and every step
+folds the visiting shard's partial attention into a running online-softmax
+state (m, l, acc) — mathematically identical to full attention, with
+activation memory O(S/n) per device.
+
+Causality works on GLOBAL positions carried with each shard, so left-padded
+batches and rotary offsets need no special cases — the same position-space
+semantics as ``ops.attention``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _partial_attention(q, k, v, qp, kp, kv_valid, scale, softcap):
+    """One shard's contribution: returns (m, l, acc) online-softmax state."""
+    B, S, NH, D = q.shape
+    KVH = k.shape[2]
+    groups = NH // KVH
+    qg = q.astype(jnp.float32).reshape(B, S, KVH, groups, D)
+    s = jnp.einsum(
+        "bskgd,btkd->bkgst", qg, k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
+    allowed = (kp[:, None, :] <= qp[:, :, None]) & (kv_valid[:, None, :] != 0)
+    s = jnp.where(allowed[:, None, None, :, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B,KVH,G,S,1]
+    # Explicit mask: on a row with no allowed keys in ANY shard, m stays
+    # _NEG_INF everywhere and exp(s - m) would be 1 per entry — the mask
+    # keeps l at 0 so such rows combine to zeros, matching the oracle.
+    p = jnp.exp(s - m) * allowed[:, None, None, :, :].astype(jnp.float32)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bkgst,btkd->bkgsd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def _ring_body(q, k, v, qp, kp, kv_valid, *, axis_name, scale, softcap):
+    """Runs inside shard_map: local blocks only; K/V rotate around the ring."""
+    n = jax.lax.psum(1, axis_name)
+    B, S, NH, D = q.shape
+
+    m = jnp.full((B, k.shape[2], NH // k.shape[2], S, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros_like(m)
+    acc = jnp.zeros((B, k.shape[2], NH // k.shape[2], S, D), jnp.float32)
+    # The online-softmax state is per-shard data: mark it varying over the
+    # ring axis so the loop carry type matches the (varying) step outputs.
+    m, l, acc = jax.lax.pvary((m, l, acc), axis_name)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        m, l, acc, k, v, kp, kv_valid = carry
+        sm, sl, sacc = _partial_attention(q, k, v, qp, kp, kv_valid, scale, softcap)
+        m_new = jnp.maximum(m, sm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(sm - m_new)
+        l = l * alpha + sl * beta
+        acc = acc * alpha + sacc * beta
+        # Rotate the K/V shard (with its positions) to the next device.
+        k, v, kp, kv_valid = jax.lax.ppermute(
+            (k, v, kp, kv_valid), axis_name, perm
+        )
+        return m_new, l, acc, k, v, kp, kv_valid
+
+    m, l, acc, *_ = jax.lax.fori_loop(
+        0, n, step, (m, l, acc, k, v, kp, kv_valid)
+    )
+    out = acc / jnp.maximum(l, 1e-30)  # pad queries (nothing allowed) → zeros
+    B, KVH, G, S, D = out.shape
+    return jnp.moveaxis(out, 3, 1).reshape(B, S, KVH * G, D).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # [B, S, NH, D] — S is the GLOBAL sequence length
+    k: jax.Array,  # [B, S, KVH, D]
+    v: jax.Array,
+    q_positions: jax.Array,  # [B, S] global positions
+    kv_valid: jax.Array,  # [B, S]
+    mesh: Mesh,
+    *,
+    scale: float,
+    softcap: float | None = None,
+    axis_name: str = "seq",
+) -> jax.Array:
+    """Sequence-parallel attention over ``mesh[axis_name]``.
+
+    Inputs are global arrays; shard_map splits the sequence dim across the
+    ring, and the result comes back with the same (sequence-sharded)
+    layout. Numerically equals full causal attention.
+    """
+    shard_map = jax.shard_map
+
+    seq_spec = P(None, axis_name, None, None)
+    pos_spec = P(None, axis_name)
+    fn = shard_map(
+        functools.partial(
+            _ring_body, axis_name=axis_name, scale=scale, softcap=softcap
+        ),
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, pos_spec, pos_spec, pos_spec),
+        out_specs=seq_spec,
+    )
+    return fn(q, k, v, q_positions, q_positions, kv_valid)

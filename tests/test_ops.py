@@ -1,0 +1,216 @@
+"""ops/: flash-attention kernel vs XLA oracle (interpret mode on CPU), and
+ring attention vs full attention on the seq-sharded 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.ops import (
+    flash_attention,
+    ring_attention,
+    xla_attention,
+)
+
+
+def _inputs(key, B=2, S=40, T=56, NH=4, KVH=2, D=16, left_pad=4):
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, S, NH, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, KVH, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, KVH, D), jnp.float32)
+    # Left-padded positions: first `left_pad` slots invalid
+    qp = jnp.maximum(jnp.arange(S)[None, :] - left_pad, 0) + (T - S)
+    qp = jnp.tile(qp, (B, 1))
+    kp = jnp.maximum(jnp.arange(T)[None, :] - left_pad, 0)
+    kp = jnp.tile(kp, (B, 1))
+    kvalid = jnp.tile((jnp.arange(T) >= left_pad)[None, :], (B, 1))
+    return q, k, v, qp.astype(jnp.int32), kp.astype(jnp.int32), kvalid
+
+
+@pytest.mark.parametrize("softcap,window", [
+    (None, None),
+    (50.0, None),
+    (None, 16),
+    (30.0, 8),
+])
+def test_flash_matches_oracle(softcap, window):
+    args = _inputs(jax.random.key(0))
+    scale = 16**-0.5
+    ref = xla_attention(*args, scale=scale, softcap=softcap, window=window)
+    got = flash_attention(
+        *args, scale=scale, softcap=softcap, window=window,
+        block_q=16, block_kv=16, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_unaligned_lengths():
+    # S, T not multiples of the block sizes — exercises internal padding.
+    args = _inputs(jax.random.key(1), S=23, T=37, left_pad=3)
+    scale = 16**-0.5
+    ref = xla_attention(*args, scale=scale)
+    got = flash_attention(
+        *args, scale=scale, block_q=16, block_kv=16, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_mha_no_groups():
+    args = _inputs(jax.random.key(2), NH=2, KVH=2, left_pad=0)
+    scale = 16**-0.5
+    ref = xla_attention(*args, scale=scale)
+    got = flash_attention(
+        *args, scale=scale, block_q=16, block_kv=16, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_oracle_matches_model_attention():
+    """The position-space oracle agrees with the model's slot-space mask
+    construction on real (non-pad) rows."""
+    from introspective_awareness_tpu.models.config import tiny_config
+    from introspective_awareness_tpu.models.transformer import _attention
+
+    cfg = tiny_config()
+    B, S, NH, D = 2, 12, cfg.n_heads, cfg.head_dim
+    KVH = cfg.n_kv_heads
+    key = jax.random.key(3)
+    q = jax.random.normal(key, (B, S, NH, D), jnp.float32)
+    k = jax.random.normal(jax.random.key(4), (B, S, KVH, D), jnp.float32)
+    v = jax.random.normal(jax.random.key(5), (B, S, KVH, D), jnp.float32)
+    left_pad = 3
+    mask = (jnp.arange(S)[None, :] >= left_pad).astype(jnp.int32)
+    mask = jnp.tile(mask, (B, 1))
+    positions = jnp.maximum(jnp.cumsum(mask, axis=1) - 1, 0)
+
+    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    allowed = causal[None] & mask[:, None, :].astype(jnp.bool_)
+    ref = _attention(q, k, v, allowed, cfg)
+
+    got = xla_attention(
+        q, k, v, positions, positions, mask, scale=cfg.head_dim**-0.5
+    )
+    np.testing.assert_allclose(
+        np.asarray(got[:, left_pad:]), np.asarray(ref[:, left_pad:]),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_ring_attention_matches_full(mesh8):
+    """Seq-sharded ring attention == full attention (8-way ring)."""
+    B, S, NH, KVH, D = 2, 64, 4, 2, 16
+    q = jax.random.normal(jax.random.key(0), (B, S, NH, D), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (B, S, KVH, D), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (B, S, KVH, D), jnp.float32)
+    left_pad = 5
+    valid = jnp.tile((jnp.arange(S) >= left_pad)[None, :], (B, 1))
+    positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
+    scale = D**-0.5
+
+    ref = xla_attention(q, k, v, positions, positions, valid, scale=scale)
+
+    # Ring over a seq=8 mesh (ring length 8, 8 tokens per device).
+    from introspective_awareness_tpu.parallel import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(dp=1, tp=1, ep=1, sp=8))
+    got = ring_attention(q, k, v, positions, valid, mesh, scale=scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_with_softcap(mesh8):
+    B, S, NH, KVH, D = 1, 32, 2, 1, 8
+    q = jax.random.normal(jax.random.key(7), (B, S, NH, D), jnp.float32)
+    k = jax.random.normal(jax.random.key(8), (B, S, KVH, D), jnp.float32)
+    v = jax.random.normal(jax.random.key(9), (B, S, KVH, D), jnp.float32)
+    valid = jnp.ones((B, S), jnp.int32)
+    positions = jnp.tile(jnp.arange(S)[None, :], (B, 1))
+    scale = D**-0.5
+
+    from introspective_awareness_tpu.parallel import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(dp=1, tp=1, ep=1, sp=8))
+    ref = xla_attention(q, k, v, positions, positions, valid, scale=scale, softcap=20.0)
+    got = ring_attention(q, k, v, positions, valid, mesh, scale=scale, softcap=20.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_window_is_runtime_operand():
+    """Changing the window must not change results vs oracle, and a traced
+    scalar window must work (Gemma per-layer local/global in one kernel)."""
+    args = _inputs(jax.random.key(4), left_pad=0)
+    scale = 16**-0.5
+    for w in (0, 8, 24):
+        ref = xla_attention(*args, scale=scale, window=w if w else None)
+        got = flash_attention(
+            *args, scale=scale, window=jnp.int32(w),
+            block_q=16, block_kv=16, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_model_forward_flash_matches_xla():
+    """Full model forward with attn_impl=flash == xla (prefill + extraction),
+    including a Gemma-style config with sliding windows and softcaps."""
+    import dataclasses
+
+    from introspective_awareness_tpu.models.config import tiny_config
+    from introspective_awareness_tpu.models.transformer import (
+        forward,
+        init_params,
+        make_positions,
+    )
+
+    for base in (
+        tiny_config(n_layers=3),
+        tiny_config(
+            n_layers=4, attn_logit_softcap=50.0, final_logit_softcap=30.0,
+            use_post_norms=True, norm_scale_plus_one=True, embed_scale=True,
+            sliding_window=8, sliding_window_pattern=2,
+        ),
+    ):
+        cfg_flash = dataclasses.replace(base, attn_impl="flash")
+        params = init_params(base, jax.random.key(0))
+        ids = jax.random.randint(jax.random.key(1), (2, 20), 0, base.vocab_size)
+        mask = jnp.ones((2, 20), jnp.int32).at[0, :4].set(0)
+        pos = make_positions(mask)
+
+        ref = forward(params, base, ids, mask, pos, capture=True, logits_mode="last")
+        got = forward(params, cfg_flash, ids, mask, pos, capture=True, logits_mode="last")
+        np.testing.assert_allclose(
+            np.asarray(got.logits), np.asarray(ref.logits), rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(got.captured), np.asarray(ref.captured), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_fully_masked_rows_yield_zeros():
+    """A batch row with no valid keys (all padding) must output zeros from
+    BOTH the kernel and the oracle — not mean-of-V from exp(0)=1."""
+    q, k, v, qp, kp, kvalid = _inputs(jax.random.key(5), B=2, left_pad=0)
+    kvalid = kvalid.at[1, :].set(False)  # row 1: nothing attendable
+    scale = 16**-0.5
+    ref = xla_attention(q, k, v, qp, kp, kvalid, scale=scale)
+    got = flash_attention(
+        q, k, v, qp, kp, kvalid, scale=scale,
+        block_q=16, block_kv=16, interpret=True,
+    )
+    assert np.allclose(np.asarray(ref[1]), 0.0)
+    assert np.allclose(np.asarray(got[1]), 0.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_fully_masked_row(mesh8):
+    from introspective_awareness_tpu.parallel import MeshConfig, build_mesh
+
+    B, S, NH, KVH, D = 2, 32, 2, 1, 8
+    q = jax.random.normal(jax.random.key(0), (B, S, NH, D), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (B, S, KVH, D), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (B, S, KVH, D), jnp.float32)
+    valid = jnp.ones((B, S), jnp.int32).at[1, :].set(0)
+    positions = jnp.tile(jnp.arange(S)[None, :], (B, 1))
+    mesh = build_mesh(MeshConfig(dp=1, tp=1, ep=1, sp=8))
+    got = ring_attention(q, k, v, positions, valid, mesh, scale=D**-0.5)
+    assert np.allclose(np.asarray(got[1]), 0.0)
