@@ -53,6 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-od", "--output-dir", type=str, default=DEFAULT_OUTPUT_DIR)
     parser.add_argument("-dt", "--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float16", "float32"])
+    parser.add_argument("-q", "--quantization", type=str, default=None,
+                        choices=["8bit", "4bit"],
+                        help="Weight-only int8/int4 quantization "
+                             "(per-output-channel scales, dequant fused into "
+                             "the matmul)")
     parser.add_argument("-em", "--extraction-method", type=str, default="baseline",
                         choices=["baseline", "simple", "no_baseline"])
     parser.add_argument("-nlj", "--no-llm-judge", action="store_true",
@@ -75,6 +80,14 @@ def build_parser() -> argparse.ArgumentParser:
                              "on-device = co-resident JAX grader; none = keyword only")
     parser.add_argument("--judge-model", type=str, default="gpt-4.1-nano",
                         help="Judge model: API name, checkpoint dir, or tiny[:seed]")
+    parser.add_argument("--attn-impl", type=str, default="xla",
+                        choices=["xla", "flash"],
+                        help="Attention for prefill/extraction: fused einsum "
+                             "(xla) or the Pallas flash kernel")
+    parser.add_argument("--debug-nans", action="store_true",
+                        help="Sanitizer mode: raise on NaN/Inf inside jit")
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="Capture a jax.profiler trace of the sweep here")
     return parser
 
 

@@ -128,6 +128,18 @@ def load_subject(name: str, args, mesh, rules):
         args.dtype
     ]
 
+    def finalize(runner):
+        if getattr(args, "attn_impl", "xla") != "xla":
+            import dataclasses
+
+            runner.cfg = dataclasses.replace(runner.cfg, attn_impl=args.attn_impl)
+        if getattr(args, "quantization", None):
+            from introspective_awareness_tpu.models.quant import quantize_params
+
+            bits = {"8bit": 8, "4bit": 4}[args.quantization]
+            runner.params = quantize_params(runner.params, bits=bits, dtype=dtype)
+        return runner
+
     if name.startswith("tiny"):
         seed = int(name.split(":", 1)[1]) if ":" in name else 0
         cfg = tiny_config(n_layers=4)
@@ -136,10 +148,10 @@ def load_subject(name: str, args, mesh, rules):
             params = shax.shard_params(
                 params, param_logical_axes(cfg), mesh, rules
             )
-        return ModelRunner(
+        return finalize(ModelRunner(
             params, cfg, ByteTokenizer(), model_name=name, mesh=mesh, rules=rules,
             seed=args.seed,
-        )
+        ))
 
     from introspective_awareness_tpu.models.loader import load_model
     from introspective_awareness_tpu.models.registry import resolve_model_name
@@ -152,9 +164,9 @@ def load_subject(name: str, args, mesh, rules):
                 f"{name!r} is not a checkpoint directory; download the HF repo "
                 f"({path}) and pass its local path"
             )
-    return load_model(
+    return finalize(load_model(
         path, mesh=mesh, rules=rules, dtype=dtype, model_name=name, seed=args.seed
-    )
+    ))
 
 
 def run_sweep(args, runner, judge, model_name: str) -> dict:
@@ -393,6 +405,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     from introspective_awareness_tpu.parallel import MeshConfig, ShardingRules, build_mesh
 
     args = parse_args(argv)
+    if args.debug_nans:
+        from introspective_awareness_tpu.utils import enable_debug_checks
+
+        enable_debug_checks()
     models = list(args.models)
     if models == ["all"]:
         models = _scan_models(args.output_dir)
@@ -430,8 +446,11 @@ def main(argv: Optional[list[str]] = None) -> int:
                             "results": saved.get("results", []), **saved.get("metrics", {})
                         }
         else:
+            from introspective_awareness_tpu.utils import profile_trace
+
             runner = load_subject(model_name, args, mesh, rules)
-            all_results = run_sweep(args, runner, judge, model_name)
+            with profile_trace(args.profile_dir):
+                all_results = run_sweep(args, runner, judge, model_name)
             write_debug_dumps(out_base, runner, args, all_results)
             runner.cleanup()
 

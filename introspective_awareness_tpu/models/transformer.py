@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from introspective_awareness_tpu.models.config import ModelConfig
+from introspective_awareness_tpu.models.quant import maybe_dequant as W
 from introspective_awareness_tpu.parallel import sharding as shax
 
 # Big negative for masked attention logits (avoid -inf NaN propagation in bf16).
@@ -389,9 +390,9 @@ def forward(
         lp, layer_id, sliding = xs["p"], xs["layer_id"], xs["sliding"]
 
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps, plus1)
-        q = jnp.einsum("bsh,hq->bsq", x, lp["wq"])
-        k = jnp.einsum("bsh,hk->bsk", x, lp["wk"])
-        v = jnp.einsum("bsh,hk->bsk", x, lp["wv"])
+        q = jnp.einsum("bsh,hq->bsq", x, W(lp["wq"]))
+        k = jnp.einsum("bsh,hk->bsk", x, W(lp["wk"]))
+        v = jnp.einsum("bsh,hk->bsk", x, W(lp["wv"]))
         if cfg.qkv_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
@@ -435,7 +436,7 @@ def forward(
         else:
             amask = jnp.where(sliding, allowed_local, allowed) if cfg.sliding_window else allowed
             attn = _attention(q, k_att, v_att, amask, cfg)
-        attn = jnp.einsum("bsq,qh->bsh", attn.reshape(B, S, cfg.q_dim), lp["wo"])
+        attn = jnp.einsum("bsq,qh->bsh", attn.reshape(B, S, cfg.q_dim), W(lp["wo"]))
         if cfg.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], cfg.rms_eps, plus1)
         h = h + attn
@@ -444,9 +445,9 @@ def forward(
         if cfg.is_moe:
             mlp = _moe_mlp(x, lp, cfg)
         else:
-            gate = jnp.einsum("bsh,hm->bsm", x, lp["w_gate"])
-            up = jnp.einsum("bsh,hm->bsm", x, lp["w_up"])
-            mlp = jnp.einsum("bsm,mh->bsh", mlp_act(gate, cfg) * up, lp["w_down"])
+            gate = jnp.einsum("bsh,hm->bsm", x, W(lp["w_gate"]))
+            up = jnp.einsum("bsh,hm->bsm", x, W(lp["w_up"]))
+            mlp = jnp.einsum("bsm,mh->bsh", mlp_act(gate, cfg) * up, W(lp["w_down"]))
         if cfg.use_post_norms:
             mlp = rms_norm(mlp, lp["post_mlp_norm"], cfg.rms_eps, plus1)
         h = h + mlp
@@ -506,7 +507,9 @@ def _moe_mlp(x: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
     (BASELINE.json config #5) composes with this because steering happens on
     the combined residual stream.
     """
-    logits = jnp.einsum("bsh,he->bse", x, lp["router"], preferred_element_type=jnp.float32)
+    logits = jnp.einsum(
+        "bsh,he->bse", x, W(lp["router"]), preferred_element_type=jnp.float32
+    )
     probs = jax.nn.softmax(logits, axis=-1)
     topv, topi = lax.top_k(probs, cfg.n_experts_per_tok)  # [B,S,K]
     topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
@@ -514,10 +517,10 @@ def _moe_mlp(x: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
         jax.nn.one_hot(topi, cfg.n_experts, dtype=x.dtype) * topv[..., None].astype(x.dtype),
         axis=2,
     )  # [B, S, E]
-    gate = jnp.einsum("bsh,ehm->ebsm", x, lp["w_gate"])
-    up = jnp.einsum("bsh,ehm->ebsm", x, lp["w_up"])
+    gate = jnp.einsum("bsh,ehm->ebsm", x, W(lp["w_gate"]))
+    up = jnp.einsum("bsh,ehm->ebsm", x, W(lp["w_up"]))
     act = mlp_act(gate, cfg) * up
-    eo = jnp.einsum("ebsm,emh->ebsh", act, lp["w_down"])
+    eo = jnp.einsum("ebsm,emh->ebsh", act, W(lp["w_down"]))
     return jnp.einsum("ebsh,bse->bsh", eo, combine)
 
 

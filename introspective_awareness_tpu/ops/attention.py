@@ -52,32 +52,49 @@ def _flash_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    k = k_ref[0, 0, :, :].astype(jnp.float32)  # [BK, D]
-    v = v_ref[0, 0, :, :].astype(jnp.float32)
     kp = kpos_ref[0, 0, :]  # [BK]
     valid = kvalid_ref[0, 0, :]
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [BQ, BK]
-    if softcap is not None:
-        s = softcap * jnp.tanh(s / softcap)
-    allowed = (kp[None, :] <= qp[:, None]) & (valid[None, :] != 0)
-    allowed &= (window <= 0) | ((qp[:, None] - kp[None, :]) < window)
-    s = jnp.where(allowed, s, _NEG_INF)
-
-    m = m_scr[:]
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-    # Multiply by `allowed`, don't rely on exp underflow: on a fully-masked
-    # row m_new is still _NEG_INF, so exp(s - m_new) = exp(0) = 1 for every
-    # masked entry — the explicit mask keeps l at 0 there (row → zeros).
-    p = jnp.exp(s - m_new) * allowed.astype(jnp.float32)
-    alpha = jnp.exp(m - m_new)
-    m_scr[:] = m_new
-    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    # Causal / window block skip: positions are monotone over slots for all
+    # model-produced inputs, so a whole KV tile is dead when its smallest
+    # valid position exceeds the q block's largest (future tile), or — with a
+    # window — its largest valid position has already scrolled out. The MXU
+    # work and the softmax update are skipped for dead tiles (the DMA of the
+    # tile itself is issued by the pipeline either way).
+    has_valid = valid != 0
+    kp_min = jnp.min(jnp.where(has_valid, kp, jnp.int32(2**30)))
+    kp_max = jnp.max(jnp.where(has_valid, kp, jnp.int32(-(2**30))))
+    qp_min, qp_max = jnp.min(qp), jnp.max(qp)
+    tile_live = (kp_min <= qp_max) & (
+        (window <= 0) | (kp_max > qp_min - window)
     )
+
+    @pl.when(tile_live)
+    def _update():
+        k = k_ref[0, 0, :, :].astype(jnp.float32)  # [BK, D]
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [BQ, BK]
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
+        allowed = (kp[None, :] <= qp[:, None]) & has_valid[None, :]
+        allowed &= (window <= 0) | ((qp[:, None] - kp[None, :]) < window)
+        s = jnp.where(allowed, s, _NEG_INF)
+
+        m = m_scr[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # Multiply by `allowed`, don't rely on exp underflow: on a fully-
+        # masked row m_new is still _NEG_INF, so exp(s - m_new) = exp(0) = 1
+        # for every masked entry — the explicit mask keeps l at 0 there
+        # (row → zeros).
+        p = jnp.exp(s - m_new) * allowed.astype(jnp.float32)
+        alpha = jnp.exp(m - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
 
     @pl.when(t == pl.num_programs(3) - 1)
     def _finish():
@@ -176,18 +193,24 @@ def flash_attention(
     return out.transpose(0, 2, 1, 3)[:, :S]
 
 
-def xla_attention(
-    q, k, v, q_positions, kv_positions, kv_valid,
+def gqa_masked_scores(
+    q, k, q_positions, kv_positions, kv_valid,
     *, scale, softcap=None, window=None,
-) -> jax.Array:
-    """Reference implementation with identical position-space semantics —
-    the fallback path and the kernel's correctness oracle."""
+):
+    """Shared GQA score computation with position-space masking.
+
+    Returns ``(s, allowed)``: masked scores ``[B, KVH, G, S, T]`` (f32,
+    ``_NEG_INF`` where disallowed) and the mask ``[B, S, T]``. Used by the
+    XLA fallback/oracle below and by ring attention's per-shard partials
+    (ops/ring.py) so there is exactly one definition of the semantics.
+    """
     B, S, NH, D = q.shape
     KVH = k.shape[2]
     groups = NH // KVH
-    qg = q.reshape(B, S, KVH, groups, D)
+    qg = q.astype(jnp.float32).reshape(B, S, KVH, groups, D)
     s = jnp.einsum(
-        "bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32
+        "bskgd,btkd->bkgst", qg, k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
     ) * scale
     if softcap is not None:
         s = softcap * jnp.tanh(s / softcap)
@@ -200,7 +223,20 @@ def xla_attention(
         allowed &= (window <= 0) | (
             (q_positions[:, :, None] - kv_positions[:, None, :]) < window
         )
-    s = jnp.where(allowed[:, None, None, :, :], s, _NEG_INF)
+    return jnp.where(allowed[:, None, None, :, :], s, _NEG_INF), allowed
+
+
+def xla_attention(
+    q, k, v, q_positions, kv_positions, kv_valid,
+    *, scale, softcap=None, window=None,
+) -> jax.Array:
+    """Reference implementation with identical position-space semantics —
+    the fallback path and the kernel's correctness oracle."""
+    B, S, NH, D = q.shape
+    s, allowed = gqa_masked_scores(
+        q, k, q_positions, kv_positions, kv_valid,
+        scale=scale, softcap=softcap, window=window,
+    )
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bkgst,btkd->bskgd", p, v.astype(p.dtype))
     # Match the kernel's all-masked-row behavior (zeros, not uniform attn).

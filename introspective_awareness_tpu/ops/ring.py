@@ -25,19 +25,14 @@ _NEG_INF = -1e30
 
 
 def _partial_attention(q, k, v, qp, kp, kv_valid, scale, softcap):
-    """One shard's contribution: returns (m, l, acc) online-softmax state."""
-    B, S, NH, D = q.shape
-    KVH = k.shape[2]
-    groups = NH // KVH
-    qg = q.astype(jnp.float32).reshape(B, S, KVH, groups, D)
-    s = jnp.einsum(
-        "bskgd,btkd->bkgst", qg, k.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    if softcap is not None:
-        s = softcap * jnp.tanh(s / softcap)
-    allowed = (kp[:, None, :] <= qp[:, :, None]) & (kv_valid[:, None, :] != 0)
-    s = jnp.where(allowed[:, None, None, :, :], s, _NEG_INF)
+    """One shard's contribution: returns (m, l, acc) online-softmax state.
+    Score semantics come from the single shared definition
+    (ops.attention.gqa_masked_scores)."""
+    from introspective_awareness_tpu.ops.attention import gqa_masked_scores
+
+    s, allowed = gqa_masked_scores(
+        q, k, qp, kp, kv_valid, scale=scale, softcap=softcap
+    )
     m = jnp.max(s, axis=-1, keepdims=True)  # [B,KVH,G,S,1]
     # Explicit mask: on a row with no allowed keys in ANY shard, m stays
     # _NEG_INF everywhere and exp(s - m) would be 1 per entry — the mask
@@ -58,7 +53,12 @@ def _ring_body(q, k, v, qp, kp, kv_valid, *, axis_name, scale, softcap):
     acc = jnp.zeros((B, k.shape[2], NH // k.shape[2], S, D), jnp.float32)
     # The online-softmax state is per-shard data: mark it varying over the
     # ring axis so the loop carry type matches the (varying) step outputs.
-    m, l, acc = jax.lax.pvary((m, l, acc), axis_name)
+    # (pvary is being renamed; prefer the new spelling when present.)
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        m, l, acc = pvary((m, l, acc), axis_name)
+    else:  # pragma: no cover - future JAX
+        m, l, acc = jax.lax.pcast((m, l, acc), to=axis_name)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 

@@ -1,0 +1,10 @@
+"""Cross-cutting utilities: tracing/profiling and numeric sanitizers."""
+
+from introspective_awareness_tpu.utils.observability import (
+    Timings,
+    enable_debug_checks,
+    profile_trace,
+    timed,
+)
+
+__all__ = ["Timings", "enable_debug_checks", "profile_trace", "timed"]

@@ -1,0 +1,122 @@
+"""Quantization (int8/int4 weight-only) + observability utilities."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.models.config import tiny_config
+from introspective_awareness_tpu.models.quant import (
+    QUANTIZABLE,
+    QuantizedTensor,
+    quantize_params,
+    quantize_tensor,
+)
+from introspective_awareness_tpu.models.transformer import (
+    forward,
+    init_params,
+    make_positions,
+)
+from introspective_awareness_tpu.utils import Timings, timed
+
+
+def test_quantize_tensor_roundtrip_error():
+    w = jax.random.normal(jax.random.key(0), (64, 32), jnp.float32)
+    qt8 = quantize_tensor(w, 8, dtype=jnp.float32)
+    assert qt8.q.dtype == jnp.int8
+    assert qt8.scale.shape == (1, 32)
+    err8 = float(jnp.abs(qt8.dequant() - w).max() / jnp.abs(w).max())
+    assert err8 < 0.01, err8
+    qt4 = quantize_tensor(w, 4, dtype=jnp.float32)
+    assert qt4.q.dtype == jnp.int4
+    err4 = float(jnp.abs(qt4.dequant() - w).max() / jnp.abs(w).max())
+    assert err4 < 0.12, err4
+    assert err8 < err4
+    with pytest.raises(ValueError, match="bits must be"):
+        quantize_tensor(w, 3)
+
+
+def test_quantized_tensor_is_pytree():
+    qt = quantize_tensor(jnp.ones((4, 4)), 8)
+    leaves, treedef = jax.tree.flatten(qt)
+    assert len(leaves) == 2
+    rebuilt = jax.tree.unflatten(treedef, leaves)
+    assert isinstance(rebuilt, QuantizedTensor)
+    np.testing.assert_array_equal(np.asarray(rebuilt.q), np.asarray(qt.q))
+
+
+@pytest.mark.parametrize("moe", [False, True])
+def test_quantized_forward_close_to_full_precision(moe):
+    kw = dict(n_experts=4, n_experts_per_tok=2, moe_mlp_hidden=64) if moe else {}
+    cfg = tiny_config(n_layers=2, **kw)
+    params = init_params(cfg, jax.random.key(0))
+    qparams = quantize_params(params, bits=8, dtype=jnp.float32)
+    for key in QUANTIZABLE & set(qparams["layers"]):
+        assert isinstance(qparams["layers"][key], QuantizedTensor), key
+    assert not isinstance(qparams["embed"], QuantizedTensor)
+
+    ids = jax.random.randint(jax.random.key(1), (2, 12), 0, cfg.vocab_size)
+    mask = jnp.ones((2, 12), jnp.int32)
+    pos = make_positions(mask)
+    full = forward(params, cfg, ids, mask, pos, logits_mode="all")
+    quant = forward(qparams, cfg, ids, mask, pos, logits_mode="all")
+
+    def lsm(x):
+        x = np.asarray(x, np.float64)
+        x = x - x.max(-1, keepdims=True)
+        return x - np.log(np.exp(x).sum(-1, keepdims=True))
+
+    # int8 weight error compounds over layers; require close log-probs.
+    assert np.abs(lsm(full.logits) - lsm(quant.logits)).max() < 0.15
+
+
+def test_quantized_generation_runs():
+    from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    cfg = tiny_config(n_layers=2)
+    params = quantize_params(init_params(cfg, jax.random.key(0)), bits=4,
+                             dtype=jnp.float32)
+    runner = ModelRunner(params, cfg, ByteTokenizer(), model_name="tiny-q4")
+    out = runner.generate_batch(["hello", "world"], max_new_tokens=4,
+                                temperature=0.0)
+    assert len(out) == 2
+
+
+def test_cli_quantization_flag(tmp_path):
+    from introspective_awareness_tpu.cli.sweep import main
+
+    assert main([
+        "--models", "tiny", "--concepts", "Dust", "--n-baseline", "3",
+        "--layer-fraction", "0.5", "--strength", "4.0", "--n-trials", "2",
+        "--max-tokens", "4", "--temperature", "0.0",
+        "--output-dir", str(tmp_path), "--dtype", "float32",
+        "--judge-backend", "none", "--quantization", "8bit",
+    ]) == 0
+    assert (tmp_path / "tiny" / "layer_0.50_strength_4.0" / "results.json").exists()
+
+
+def test_timings_and_timed():
+    t = Timings()
+    with timed("phase_a", t):
+        pass
+    with timed("phase_a", t):
+        pass
+    with timed("phase_b", t, result=jnp.ones((4,)) * 2):
+        pass
+    d = t.as_dict()
+    assert set(d) == {"phase_a_s", "phase_b_s"}
+    assert t.counts() == {"phase_a": 2, "phase_b": 1}
+    assert d["phase_a_s"] >= 0
+
+
+def test_debug_checks_catch_nan():
+    from introspective_awareness_tpu.utils import enable_debug_checks
+
+    enable_debug_checks()
+    try:
+        with pytest.raises(Exception, match="invalid value"):
+            jax.jit(lambda x: x / 0.0 * 0.0)(jnp.float32(1.0)).block_until_ready()
+    finally:
+        jax.config.update("jax_debug_nans", False)
+        jax.config.update("jax_debug_infs", False)
