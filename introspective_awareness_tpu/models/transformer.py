@@ -44,12 +44,16 @@ class SteerSpec(NamedTuple):
 
     Semantics mirror generate_batch_with_multi_steering
     (reference model_utils.py:687-879): per-example vectors, per-example start
-    positions (already left-pad adjusted into padded coordinates), one target
-    layer, one scalar strength.
+    positions (already left-pad adjusted into padded coordinates).
+
+    ``layer_idx`` and ``strength`` may be scalars (one cell) or ``[B]``
+    arrays — per-example targets let a whole layer x strength sweep grid run
+    as ONE batched generation (the BASELINE.json "vmaps the layer x strength
+    grid" design).
     """
 
-    layer_idx: jax.Array  # int32 scalar: which layer's output residual to steer
-    strength: jax.Array  # f32 scalar multiplier
+    layer_idx: jax.Array  # int32 scalar or [B]: target layer per example
+    strength: jax.Array  # f32 scalar or [B]: multiplier per example
     vectors: jax.Array  # [B, H] per-example steering vectors (un-scaled)
     pos_mask: jax.Array  # [B, S] float 0/1: positions (padded coords) to steer
 
@@ -374,8 +378,12 @@ def forward(
 
     if steer is None:
         steer = no_steer(B, S, cfg.hidden_size, jnp.float32)
+    steer_layer = jnp.broadcast_to(jnp.asarray(steer.layer_idx, jnp.int32), (B,))
+    steer_strength = jnp.broadcast_to(
+        jnp.asarray(steer.strength, jnp.float32), (B,)
+    )
     steer_add = (
-        steer.strength
+        steer_strength[:, None, None]
         * steer.vectors[:, None, :].astype(jnp.float32)
         * steer.pos_mask[:, :, None].astype(jnp.float32)
     )  # [B, S, H]
@@ -453,8 +461,8 @@ def forward(
         h = h + mlp
 
         # --- traced steering injection (the hook replacement) ----------------
-        gain = (layer_id == steer.layer_idx).astype(jnp.float32)
-        h = (h.astype(jnp.float32) + gain * steer_add).astype(h.dtype)
+        gain = (layer_id == steer_layer).astype(jnp.float32)  # [B]
+        h = (h.astype(jnp.float32) + gain[:, None, None] * steer_add).astype(h.dtype)
 
         ys = {}
         if use_cache:

@@ -32,6 +32,7 @@ from introspective_awareness_tpu.protocol.trials import (
     run_batch_introspection_tests,
     run_forced_noticing_test,
     run_forced_noticing_test_batch,
+    run_grid_pass,
     run_steered_introspection_test,
     run_steered_introspection_test_batch,
     run_trial_pass,
@@ -60,6 +61,7 @@ __all__ = [
     "run_batch_introspection_tests",
     "run_forced_noticing_test",
     "run_forced_noticing_test_batch",
+    "run_grid_pass",
     "run_steered_introspection_test",
     "run_steered_introspection_test_batch",
     "run_trial_pass",

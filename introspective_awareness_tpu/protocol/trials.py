@@ -96,6 +96,77 @@ def run_trial_pass(
     return results
 
 
+def run_grid_pass(
+    runner,
+    trial_type: str,
+    tasks: Sequence[tuple[str, int, float, int, float]],
+    # (concept, trial_number, layer_fraction, layer_idx, strength)
+    vector_lookup,  # (layer_fraction, concept) -> np.ndarray [H]
+    max_new_tokens: int = 100,
+    temperature: float = 1.0,
+    batch_size: int = 256,
+    seed: Optional[int] = None,
+) -> list[dict]:
+    """One batched pass where every row may belong to a DIFFERENT
+    (layer, strength) cell — the fused-sweep path.
+
+    Layer index and strength are per-example runtime operands
+    (models/transformer.py SteerSpec), so the whole layer x strength grid
+    packs into full batches on one executable instead of one underfilled
+    generate call per cell. Same result schema as ``run_trial_pass``.
+    """
+    if trial_type not in TRIAL_TYPES:
+        raise ValueError(f"unknown trial_type {trial_type!r} (expected {TRIAL_TYPES})")
+    injected = trial_type != "control"
+
+    render_cache: dict[int, tuple[str, Optional[int]]] = {}
+
+    def rendered(trial_num: int) -> tuple[str, Optional[int]]:
+        if trial_num not in render_cache:
+            render_cache[trial_num] = render_trial_prompt(
+                runner.tokenizer, runner.model_name, trial_num, trial_type
+            )
+        return render_cache[trial_num]
+
+    results: list[dict] = []
+    for start in range(0, len(tasks), batch_size):
+        batch = tasks[start : start + batch_size]
+        prompts, starts, vecs, layers, strengths = [], [], [], [], []
+        for concept, trial_num, lf, layer_idx, strength in batch:
+            prompt, steer_start = rendered(trial_num)
+            prompts.append(prompt)
+            starts.append(steer_start)
+            vecs.append(np.asarray(vector_lookup(lf, concept), np.float32))
+            layers.append(layer_idx)
+            strengths.append(strength if injected else 0.0)
+
+        responses = runner.generate_batch_with_grid_steering(
+            prompts,
+            layer_indices=layers,
+            steering_vectors=vecs,
+            strengths=strengths,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            steering_start_positions=starts,
+            seed=None if seed is None else seed + start,
+        )
+        for (concept, trial_num, lf, layer_idx, strength), response in zip(
+            batch, responses
+        ):
+            results.append({
+                "concept": concept,
+                "trial": trial_num,
+                "response": response,
+                "injected": injected,
+                "layer": layer_idx,
+                "layer_fraction": lf,
+                "strength": strength,
+                "detected": check_concept_mentioned(response, concept),
+                "trial_type": trial_type,
+            })
+    return results
+
+
 # ---------------------------------------------------------------------------
 # Reference-parity runner surface (thin wrappers over run_trial_pass)
 # ---------------------------------------------------------------------------

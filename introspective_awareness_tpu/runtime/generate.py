@@ -38,8 +38,8 @@ class GenSpec(NamedTuple):
 
     rng: jax.Array  # PRNG key
     temperature: jax.Array  # f32 scalar; <= 0 → greedy
-    steer_layer: jax.Array  # int32 scalar
-    steer_strength: jax.Array  # f32 scalar; 0 disables steering exactly
+    steer_layer: jax.Array  # int32 scalar or [B] (per-example grid cells)
+    steer_strength: jax.Array  # f32 scalar or [B]; 0 disables steering exactly
     steer_vectors: jax.Array  # [B, H]
     steer_start: jax.Array  # [B] int32, PADDED coords; 0 = steer whole prompt
     eos_ids: jax.Array  # [n_eos] int32
@@ -92,8 +92,20 @@ def generate_tokens(
     tok0 = sample(r.logits, sub)
     done0 = jnp.isin(tok0, spec.eos_ids)
 
-    def step(carry, t):
-        cache, prev, done, key = carry
+    # Early-exit decode: a while_loop stops as soon as every row has hit EOS
+    # (the reference's model.generate stops the same way). At temp 1.0 most
+    # introspection responses end well before max_tokens, so this trims the
+    # tail of dead decode steps; the padded-token output is identical to a
+    # full-length scan.
+    tokens0 = jnp.full((B, max_new_tokens), spec.pad_id, jnp.int32)
+    tokens0 = tokens0.at[:, 0].set(tok0)
+
+    def cond(carry):
+        t, _cache, _prev, done, _key, _tokens = carry
+        return (t < max_new_tokens) & ~jnp.all(done)
+
+    def body(carry):
+        t, cache, prev, done, key, tokens = carry
         key, sub = jax.random.split(key)
         step_pos = (true_len + t - 1)[:, None]
         out = forward(
@@ -103,13 +115,12 @@ def generate_tokens(
         nxt = sample(out.logits, sub)
         nxt = jnp.where(done, spec.pad_id, nxt)
         done = done | jnp.isin(nxt, spec.eos_ids)
-        return (out.cache, nxt, done, key), nxt
+        tokens = lax.dynamic_update_slice(tokens, nxt[:, None], (0, t))
+        return t + 1, out.cache, nxt, done, key, tokens
 
     if max_new_tokens > 1:
-        (_, _, _, _), rest = lax.scan(
-            step, (r.cache, tok0, done0, key), jnp.arange(1, max_new_tokens)
-        )
-        tokens = jnp.concatenate([tok0[:, None], rest.T], axis=1)
+        carry = (jnp.int32(1), r.cache, tok0, done0, key, tokens0)
+        _, _, _, _, _, tokens = lax.while_loop(cond, body, carry)
     else:
-        tokens = tok0[:, None]
+        tokens = tokens0
     return tokens

@@ -170,18 +170,34 @@ class ModelRunner:
         # Normalize negative layer indices (the reference's list indexing
         # allows layer_idx=-1 to mean the last layer, model_utils.py:286);
         # out-of-range must fail loudly, not silently disable steering.
-        if not -self.cfg.n_layers <= layer_idx < self.cfg.n_layers:
+        # Per-example arrays (the fused sweep grid) get the same treatment.
+        layer_arr = np.asarray(layer_idx, np.int64)
+        if not ((-self.cfg.n_layers <= layer_arr) & (layer_arr < self.cfg.n_layers)).all():
             raise ValueError(
                 f"layer_idx {layer_idx} out of range for {self.cfg.n_layers} layers"
             )
-        layer_idx = layer_idx % self.cfg.n_layers
+        layer_arr = layer_arr % self.cfg.n_layers
         ids, mask, lens, B = self._prep(prompts)
         Bp, S = ids.shape
         H = self.cfg.hidden_size
 
+        if layer_arr.ndim == 0:
+            steer_layer = jnp.int32(layer_arr)
+        else:
+            steer_layer = jnp.asarray(
+                np.concatenate([layer_arr, np.zeros(Bp - B, np.int64)]), jnp.int32
+            )
+        strength_arr = np.asarray(strength, np.float32)
+        if strength_arr.ndim == 0:
+            steer_strength = jnp.float32(strength_arr)
+        else:
+            steer_strength = jnp.asarray(
+                np.concatenate([strength_arr, np.zeros(Bp - B, np.float32)])
+            )
+
         if steering_vectors is None:
             vecs = np.zeros((Bp, H), np.float32)
-            strength = 0.0
+            steer_strength = jnp.float32(0.0)
         else:
             vecs = np.zeros((Bp, H), np.float32)
             vecs[:B] = np.asarray(steering_vectors, np.float32)
@@ -197,8 +213,8 @@ class ModelRunner:
         spec = GenSpec(
             rng=self._next_key(seed),
             temperature=jnp.float32(temperature),
-            steer_layer=jnp.int32(layer_idx),
-            steer_strength=jnp.float32(strength),
+            steer_layer=steer_layer,
+            steer_strength=steer_strength,
             steer_vectors=self._shard_batch(jnp.asarray(vecs)),
             steer_start=self._shard_batch(jnp.asarray(starts)),
             eos_ids=jnp.asarray(list(self.tokenizer.eos_ids), jnp.int32),
@@ -312,6 +328,38 @@ class ModelRunner:
             layer_idx=layer_idx,
             steering_vectors=np.stack([np.asarray(v) for v in steering_vectors]),
             strength=strength,
+            steering_start_positions=steering_start_positions,
+            seed=seed,
+            debug=debug,
+        )
+
+    def generate_batch_with_grid_steering(
+        self,
+        prompts: Sequence[str],
+        layer_indices: Sequence[int],
+        steering_vectors: Sequence[np.ndarray],
+        strengths: Sequence[float],
+        max_new_tokens: int = 512,
+        temperature: float = 0.0,
+        steering_start_positions: Optional[Sequence[Optional[int]]] = None,
+        seed: Optional[int] = None,
+        debug: bool = False,
+        **kw,
+    ) -> list[str]:
+        """Per-prompt (layer, strength, vector) — the fused-sweep workhorse.
+
+        Every row of the batch can belong to a different layer x strength
+        cell, so the whole sweep grid packs into full batches on the same
+        compiled executable (no reference counterpart: its hooks steer one
+        (layer, strength) per generate call)."""
+        assert len(prompts) == len(steering_vectors) == len(layer_indices) == len(strengths)
+        return self._generate(
+            list(prompts),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            layer_idx=list(layer_indices),
+            steering_vectors=np.stack([np.asarray(v) for v in steering_vectors]),
+            strength=list(strengths),
             steering_start_positions=steering_start_positions,
             seed=seed,
             debug=debug,
