@@ -88,6 +88,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Sanitizer mode: raise on NaN/Inf inside jit")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="Capture a jax.profiler trace of the sweep here")
+    parser.add_argument("--compilation-cache-dir", type=str, default="auto",
+                        help="Persistent XLA compilation cache: 'auto' "
+                             "(~/.cache/introspective_awareness_tpu/xla), "
+                             "'off', or a directory path. Warm process "
+                             "restarts (sweep resume after preemption) then "
+                             "skip recompilation.")
     return parser
 
 

@@ -207,3 +207,72 @@ def create_cross_model_comparison_plots(
     fig.tight_layout()
     fig.savefig(shared / "model_comparison_heatmaps.png", dpi=100)
     plt.close(fig)
+
+    _layer_sweep_lines(plt, shared, cells_by_model, names)
+
+
+def _se(p, n) -> float:
+    """Binomial standard error; metrics cells don't persist SE fields."""
+    if p is None or not n:
+        return 0.0
+    return float(np.sqrt(max(p * (1 - p), 0.0) / n))
+
+
+def _layer_sweep_lines(plt, shared: Path, cells_by_model: dict, names) -> None:
+    """Third cross-model figure (reference :975-1071,
+    model_comparison_layer_sweep.png): per model, at each layer fraction take
+    the best-strength cell by introspection rate, and draw hit-rate and
+    introspection-rate lines over layer fraction with binomial-SE bars."""
+    all_lfs = sorted({lf for cells in cells_by_model.values() for lf, _ in cells})
+    if len(all_lfs) < 2:
+        return
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(16, 7))
+    max_intro = 0.0
+    for model in names:
+        cells = cells_by_model[model]
+        lfs, hits, hit_ses, intros, intro_ses = [], [], [], [], []
+        for lf in all_lfs:
+            layer_cells = [m for (clf, _), m in cells.items() if clf == lf]
+            if not layer_cells:
+                continue
+            # Judged cells outrank keyword-only ones (whose introspection rate
+            # is None); an unjudged layer appears as a NaN gap in the
+            # introspection line, never as a measured-looking 0.0.
+            best = max(
+                layer_cells,
+                key=lambda m: (
+                    m.get("combined_detection_and_identification_rate") is not None,
+                    m.get("combined_detection_and_identification_rate") or 0,
+                ),
+            )
+            hit = best.get("detection_hit_rate") or 0.0
+            intro = best.get("combined_detection_and_identification_rate")
+            n_inj = best.get("n_injection") or 0
+            lfs.append(lf)
+            hits.append(hit)
+            hit_ses.append(_se(hit, n_inj))
+            intros.append(np.nan if intro is None else intro)
+            intro_ses.append(0.0 if intro is None else _se(intro, n_inj))
+        if not lfs:
+            continue
+        ax1.errorbar(lfs, hits, yerr=hit_ses, marker="o", capsize=4, label=model)
+        ax2.errorbar(lfs, intros, yerr=intro_ses, marker="o", capsize=4, label=model)
+        finite = [i + s for i, s in zip(intros, intro_ses) if np.isfinite(i)]
+        if finite:
+            max_intro = max(max_intro, max(finite))
+
+    ax1.set_xlabel("Layer fraction")
+    ax1.set_ylabel("True positive rate")
+    ax1.set_title("True positive rate across layers")
+    ax1.set_ylim(0, 1.1)
+    ax2.set_xlabel("Layer fraction")
+    ax2.set_ylabel("P(Detect ∧ Correct ID | Injection)")
+    ax2.set_title("Introspection across layers")
+    ax2.set_ylim(0, max_intro * 1.1 if max_intro > 0 else 1.1)
+    handles, labels = ax1.get_legend_handles_labels()
+    fig.legend(handles, labels, loc="lower center", ncol=max(len(labels), 1))
+    fig.tight_layout()
+    fig.subplots_adjust(bottom=0.15)
+    fig.savefig(shared / "model_comparison_layer_sweep.png", dpi=100)
+    plt.close(fig)

@@ -56,15 +56,19 @@ def _keyword_metrics(results: list[dict]) -> dict:
         "n_injection": len(injection),
         "n_control": len(control),
         "n_forced": len(forced),
+        "metrics_source": "keyword",
         "detection_hit_rate": (
             sum(r["detected"] for r in injection) / len(injection) if injection else 0
         ),
         "detection_false_alarm_rate": (
             sum(r["detected"] for r in control) / len(control) if control else 0
         ),
-        "detection_accuracy": 0,
-        "identification_accuracy_given_claim": 0,
-        "combined_detection_and_identification_rate": 0,
+        # Judge-only metrics are None, not 0 — a fake zero reads as a measured
+        # value in results.json and downstream plots (reference :2094-2122
+        # likewise distinguishes keyword-derived fallbacks).
+        "detection_accuracy": None,
+        "identification_accuracy_given_claim": None,
+        "combined_detection_and_identification_rate": None,
         "forced_identification_accuracy": (
             sum(r["detected"] for r in forced) / len(forced) if forced else 0
         ),
@@ -211,9 +215,10 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
 
     all_results: dict = {}
     t_gen = 0.0
+    cell_times: list[float] = []
     for ci, lf in enumerate(layer_fractions):
         layer_idx = get_layer_at_fraction(runner.n_layers, lf)
-        for strength in strengths:
+        for si, strength in enumerate(strengths):
             cell_dir = config_dir(args.output_dir, model_name, lf, strength)
             results_file = cell_dir / "results.json"
 
@@ -247,24 +252,41 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             common = dict(
                 vectors=vectors, layer_idx=layer_idx, strength=strength,
                 max_new_tokens=args.max_tokens, temperature=args.temperature,
-                layer_fraction=lf, batch_size=args.batch_size, seed=args.seed + ci,
+                # Fold both grid indices into the seed so control trials (which
+                # ignore strength) are independent samples per cell, not
+                # byte-identical replays along the strength axis.
+                layer_fraction=lf, batch_size=args.batch_size,
+                seed=args.seed + ci * len(strengths) + si,
             )
             results = run_trial_pass(runner, "injection", tasks_inj, **common)
             results += run_trial_pass(runner, "control", tasks_ctl, **common)
             results += run_trial_pass(runner, "forced_injection", tasks_fcd, **common)
-            t_gen += time.perf_counter() - t0
+            t_cell = time.perf_counter() - t0
+            t_gen += t_cell
+            cell_times.append(round(t_cell, 3))
 
             metrics = _cell_metrics(results, judge, args, lf, layer_idx, strength)
             _save_cell(results, metrics, cell_dir)
             all_results[(lf, strength)] = {"results": results, **metrics}
+            comb = metrics.get("combined_detection_and_identification_rate")
             print(
                 f"  L={lf:.2f} S={strength}: "
                 f"hit={metrics.get('detection_hit_rate', 0):.2f} "
                 f"fa={metrics.get('detection_false_alarm_rate', 0):.2f} "
-                f"comb={metrics.get('combined_detection_and_identification_rate', 0):.2f}"
+                f"comb={'--' if comb is None else f'{comb:.2f}'}"
             )
 
     timings["generation_s"] = round(t_gen, 3)
+    if cell_times:
+        # All cells share one executable, so the first cell's surplus over the
+        # rest is compile time. With a warm persistent compilation cache a
+        # process restart shows first_cell ≈ later cells.
+        timings["generation_cells_s"] = cell_times
+        timings["first_cell_s"] = cell_times[0]
+        if len(cell_times) > 1:
+            timings["warm_cell_mean_s"] = round(
+                sum(cell_times[1:]) / (len(cell_times) - 1), 3
+            )
     _write_manifest(out_base, args, runner, timings)
     _write_summary(out_base, all_results, layer_fractions, strengths)
     return all_results
@@ -277,6 +299,7 @@ def _cell_metrics(results, judge, args, lf, layer_idx, strength) -> dict:
             evaluated = judge.evaluate_batch(results, _original_prompts(results))
             results[:] = evaluated
             metrics = compute_detection_and_identification_metrics(evaluated)
+            metrics["metrics_source"] = "judge"
         except Exception as e:  # noqa: BLE001 - degrade, don't lose responses
             print(f"  judge failed ({e}); keyword metrics")
             metrics = _keyword_metrics(results)
@@ -310,6 +333,10 @@ def _write_manifest(out_base: Path, args, runner, timings: dict) -> None:
         "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)) if mesh else None,
         "dtype": args.dtype,
         "batch_size": args.batch_size,
+        "compilation_cache_dir": (
+            None if args.compilation_cache_dir == "off"
+            else args.compilation_cache_dir
+        ),
         "timings": timings,
     }
     with open(out_base / "run_manifest.json", "w") as f:
@@ -409,6 +436,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         from introspective_awareness_tpu.utils import enable_debug_checks
 
         enable_debug_checks()
+    if args.compilation_cache_dir != "off":
+        from introspective_awareness_tpu.utils import enable_compilation_cache
+
+        args.compilation_cache_dir = enable_compilation_cache(
+            None if args.compilation_cache_dir == "auto"
+            else args.compilation_cache_dir
+        )
     models = list(args.models)
     if models == ["all"]:
         models = _scan_models(args.output_dir)

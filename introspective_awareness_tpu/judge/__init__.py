@@ -24,6 +24,7 @@ from introspective_awareness_tpu.judge.client import (
     JudgeClient,
     OnDeviceJudgeClient,
     OpenAIJudgeClient,
+    load_dotenv,
 )
 from introspective_awareness_tpu.judge.parsers import parse_grade, parse_yes_no
 from introspective_awareness_tpu.judge.judge import LLMJudge, batch_evaluate
@@ -39,6 +40,7 @@ __all__ = [
     "JudgeClient",
     "OnDeviceJudgeClient",
     "OpenAIJudgeClient",
+    "load_dotenv",
     "parse_grade",
     "parse_yes_no",
     "LLMJudge",

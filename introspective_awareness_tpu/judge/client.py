@@ -19,6 +19,32 @@ class JudgeClient(Protocol):
     def grade(self, prompts: Sequence[str]) -> list[str]: ...
 
 
+def load_dotenv(path: str | os.PathLike = ".env") -> dict[str, str]:
+    """Minimal first-party ``.env`` loader (reference eval_utils.py:22-23 uses
+    python-dotenv, not available here). KEY=VALUE lines, ``#`` comments,
+    optional single/double quotes; never overrides existing environment."""
+    loaded: dict[str, str] = {}
+    try:
+        text = open(path).read()
+    except OSError:
+        return loaded
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        key, _, value = line.partition("=")
+        key = key.strip()
+        value = value.strip()
+        if value[:1] in "'\"":
+            value = value.strip("'\"")
+        else:  # unquoted values may carry inline comments: KEY=val # comment
+            value = value.split("#", 1)[0].strip()
+        if key and key not in os.environ:
+            os.environ[key] = value
+            loaded[key] = value
+    return loaded
+
+
 class OpenAIJudgeClient:
     """Async fan-out against an OpenAI-compatible API.
 
@@ -48,6 +74,8 @@ class OpenAIJudgeClient:
         self.max_retries = max_retries
         self.timeout = timeout
         self.base_url = base_url
+        if api_key is None and "OPENAI_API_KEY" not in os.environ:
+            load_dotenv()
         self.api_key = api_key or os.environ.get("OPENAI_API_KEY")
         if not self.api_key:
             raise ValueError(

@@ -19,8 +19,12 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-# Stacked-layer matmul weights eligible for quantization.
-QUANTIZABLE = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "router"}
+# Stacked-layer matmul weights eligible for quantization. The MoE router is
+# listed separately: int4 error on router logits can flip top-k expert
+# selection (bitsandbytes setups likewise skip gate/router modules), so it is
+# only ever quantized at 8-bit.
+QUANTIZABLE = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+QUANTIZABLE_8BIT_ONLY = {"router"}
 
 
 @jax.tree_util.register_pytree_node_class
@@ -90,10 +94,11 @@ def quantize_params(params: dict, bits: int = 8, dtype=jnp.bfloat16) -> dict:
     out = dict(params)
     layers = dict(params["layers"])
     for key in list(layers):
-        if key in QUANTIZABLE:
+        if key in QUANTIZABLE or key in QUANTIZABLE_8BIT_ONLY:
+            key_bits = 8 if key in QUANTIZABLE_8BIT_ONLY else bits
             # Leading layer dim (and the expert dim for MoE weights) get
             # per-slice scales so the layer scan slices them consistently.
             batch_dims = layers[key].ndim - 2
-            layers[key] = quantize_tensor(layers[key], bits, dtype, batch_dims)
+            layers[key] = quantize_tensor(layers[key], key_bits, dtype, batch_dims)
     out["layers"] = layers
     return out

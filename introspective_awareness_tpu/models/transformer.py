@@ -423,8 +423,13 @@ def forward(
         else:
             k_att, v_att = k, v
 
+        backend = jax.default_backend()
         use_flash = (
             cfg.attn_impl == "flash" and S > 1 and (not use_cache or is_prefill)
+            # Mosaic lowers on TPU only; CPU runs the kernel in interpret mode
+            # for tests. Any other backend (e.g. GPU) falls back to the einsum
+            # path instead of failing at lowering time.
+            and backend in ("tpu", "cpu")
         )
         if use_flash:
             # Pallas fused attention over the current chunk; causal +
@@ -439,7 +444,7 @@ def forward(
                 scale=cfg.query_scale if cfg.query_scale is not None else cfg.head_dim**-0.5,
                 softcap=cfg.attn_logit_softcap,
                 window=win,
-                interpret=jax.default_backend() == "cpu",
+                interpret=backend == "cpu",
             )
         else:
             amask = jnp.where(sliding, allowed_local, allowed) if cfg.sliding_window else allowed

@@ -58,7 +58,7 @@ def _ring_body(q, k, v, qp, kp, kv_valid, *, axis_name, scale, softcap):
     if pvary is not None:
         m, l, acc = pvary((m, l, acc), axis_name)
     else:  # pragma: no cover - future JAX
-        m, l, acc = jax.lax.pcast((m, l, acc), to=axis_name)
+        m, l, acc = jax.lax.pcast((m, l, acc), axis_name, to="varying")
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 

@@ -83,7 +83,7 @@ class IntrospectionPrompt:
         # prefill text instead of opening a fresh assistant turn. Pass
         # ``model_name`` so system turns are dropped for templates without a
         # system role (Gemma family).
-        messages = filter_messages_for_model(self.to_chat_format(), model_name)
+        messages = filter_messages_for_model(self.to_chat_format(), model_name, tokenizer)
         return tokenizer.apply_chat_template(
             messages, add_generation_prompt=not self.prefill
         )
@@ -158,10 +158,51 @@ def create_abstract_concept_prompt(
     )
 
 
-def filter_messages_for_model(messages: list[dict], model_name: str) -> list[dict]:
-    """Drop system messages for chat templates without a system role
-    (reference detect_injected_thoughts.py:81-99)."""
-    if model_name in MODELS_WITHOUT_SYSTEM_ROLE:
+def template_supports_system_role(tokenizer) -> bool:
+    """Probe the tokenizer's chat template with a system turn.
+
+    The reference keys system-role support on a short-name list
+    (detect_injected_thoughts.py:81-99), which misses checkpoints loaded by
+    path (model_name is then a filesystem path). The template itself is the
+    ground truth: render a probe conversation and check that it neither raises
+    (Gemma templates historically raise TemplateError on system roles) nor
+    silently drops the system content. Cached per tokenizer instance.
+    """
+    cached = getattr(tokenizer, "_supports_system_role", None)
+    if cached is not None:
+        return cached
+    probe = "SYSROLE_PROBE_7f3a"
+    try:
+        rendered = tokenizer.apply_chat_template(
+            [
+                {"role": "system", "content": probe},
+                {"role": "user", "content": "hi"},
+            ],
+            add_generation_prompt=True,
+        )
+        ok = probe in rendered
+    except Exception:  # jinja TemplateError and friends
+        ok = False
+    try:
+        tokenizer._supports_system_role = ok
+    except AttributeError:  # pragma: no cover - slots-only tokenizer
+        pass
+    return ok
+
+
+def filter_messages_for_model(
+    messages: list[dict], model_name: str, tokenizer=None
+) -> list[dict]:
+    """Drop system messages for chat templates without a system role.
+
+    Detection order: the reference's registry short-name list
+    (detect_injected_thoughts.py:81-99) for parity, then — when a tokenizer is
+    available — a direct probe of its chat template, which also covers
+    checkpoints loaded by path whose name matches no registry entry."""
+    no_system = model_name in MODELS_WITHOUT_SYSTEM_ROLE or (
+        tokenizer is not None and not template_supports_system_role(tokenizer)
+    )
+    if no_system:
         return [m for m in messages if m.get("role") != "system"]
     return messages
 
@@ -215,7 +256,7 @@ def render_trial_prompt(
     The single implementation behind every trial runner and the sweep
     (replaces the reference's six inline copies)."""
     messages = filter_messages_for_model(
-        build_trial_messages(trial_number, trial_type), model_name
+        build_trial_messages(trial_number, trial_type), model_name, tokenizer
     )
     if trial_type == "forced_injection":
         rendered = tokenizer.apply_chat_template(messages, add_generation_prompt=False)

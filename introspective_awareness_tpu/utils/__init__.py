@@ -2,9 +2,16 @@
 
 from introspective_awareness_tpu.utils.observability import (
     Timings,
+    enable_compilation_cache,
     enable_debug_checks,
     profile_trace,
     timed,
 )
 
-__all__ = ["Timings", "enable_debug_checks", "profile_trace", "timed"]
+__all__ = [
+    "Timings",
+    "enable_compilation_cache",
+    "enable_debug_checks",
+    "profile_trace",
+    "timed",
+]

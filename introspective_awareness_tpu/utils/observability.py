@@ -78,3 +78,34 @@ def enable_debug_checks(nans: bool = True, infs: bool = True) -> None:
     """CI sanitizer mode: raise on NaN/Inf produced inside jit."""
     jax.config.update("jax_debug_nans", nans)
     jax.config.update("jax_debug_infs", infs)
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Wire the JAX persistent compilation cache (SURVEY.md §5.4 plan).
+
+    Sweep re-entry after preemption reuses the same executable shapes, so a
+    warm process start should pay near-zero compile time. Thresholds are
+    dropped to zero so even the small tiny-model test executables cache
+    (default JAX skips entries compiled in <1s).
+
+    Pure optimization: an unwritable cache location (read-only HOME in a pod
+    batch job) degrades to a warning and returns None, never aborts the run.
+    """
+    import os
+
+    cache_dir = (
+        cache_dir
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.join(
+            os.path.expanduser("~"), ".cache", "introspective_awareness_tpu", "xla"
+        )
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except OSError as e:
+        print(f"[warn] compilation cache disabled ({cache_dir}: {e})")
+        return None
+    return str(cache_dir)

@@ -183,6 +183,8 @@ def test_cross_model_plots_and_transcripts(tmp_path):
     create_cross_model_comparison_plots(tmp_path, ["modelA", "modelB"])
     assert (tmp_path / "shared" / "model_comparison_key_metrics.png").exists()
     assert (tmp_path / "shared" / "model_comparison_heatmaps.png").exists()
+    # Third figure: per-model best-strength lines over >=2 layer fractions.
+    assert (tmp_path / "shared" / "model_comparison_layer_sweep.png").exists()
 
     out = extract_example_transcripts(tmp_path, ["modelA", "modelB"])
     text = out.read_text()
@@ -191,6 +193,40 @@ def test_cross_model_plots_and_transcripts(tmp_path):
     assert "Best config: layer fraction 0.50, strength 2" in text
     assert "DETECTED, CORRECT CONCEPT" in text
     assert "FALSE POSITIVE" in text and "I notice dust" in text
+
+
+def test_keyword_metrics_judgeless_fields_are_none(sweep_out):
+    # judge-backend=none: judge-only metrics must be None (not fake zeros)
+    # and tagged with their source, so downstream plots/comparisons can skip
+    # them instead of treating them as measured values.
+    data = json.loads(
+        (sweep_out / "tiny" / "layer_0.25_strength_2.0" / "results.json").read_text()
+    )
+    m = data["metrics"]
+    assert m["metrics_source"] == "keyword"
+    assert m["detection_accuracy"] is None
+    assert m["identification_accuracy_given_claim"] is None
+    assert m["combined_detection_and_identification_rate"] is None
+    assert m["detection_hit_rate"] is not None
+
+
+def test_load_dotenv(tmp_path, monkeypatch):
+    from introspective_awareness_tpu.judge import load_dotenv
+
+    env = tmp_path / ".env"
+    env.write_text(
+        "# comment\nOPENAI_API_KEY='sk-test-123'\nEXISTING=new\n\nBROKENLINE\n"
+        "HF_TOKEN=hf-abc # inline comment\n"
+    )
+    monkeypatch.delenv("OPENAI_API_KEY", raising=False)
+    monkeypatch.delenv("HF_TOKEN", raising=False)
+    monkeypatch.setenv("EXISTING", "old")
+    loaded = load_dotenv(env)
+    assert loaded == {"OPENAI_API_KEY": "sk-test-123", "HF_TOKEN": "hf-abc"}
+    import os
+
+    assert os.environ["OPENAI_API_KEY"] == "sk-test-123"
+    assert os.environ["EXISTING"] == "old"  # never overrides
 
 
 def test_reevaluate_judge_without_model_load(sweep_out, capsys, monkeypatch):

@@ -1,0 +1,57 @@
+"""Persistent compilation cache: a warm process restart of the sweep must not
+pay the full compile again (SURVEY.md §5.4 plan; preemption-resume scenario).
+
+Runs the CLI twice in fresh subprocesses sharing one cache dir and compares
+the first-cell generation time recorded in run_manifest.json — all cells share
+one executable, so the first cell carries the compile cost.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+
+def _run_sweep(out_dir: Path, cache_dir: Path) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # single-device CPU is enough and compiles fastest
+    cmd = [
+        sys.executable, "-m", "introspective_awareness_tpu.cli",
+        "--models", "tiny",
+        "--concepts", "Dust",
+        "--n-baseline", "2",
+        "--layer-sweep", "0.5",
+        "--strength-sweep", "2.0", "4.0",
+        "--n-trials", "2",
+        "--max-tokens", "4",
+        "--batch-size", "8",
+        "--temperature", "0.0",
+        "--dtype", "float32",
+        "--judge-backend", "none",
+        "--no-save-vectors",
+        "--output-dir", str(out_dir),
+        "--compilation-cache-dir", str(cache_dir),
+    ]
+    proc = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=600,
+        cwd=str(Path(__file__).resolve().parent.parent),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads((out_dir / "tiny" / "run_manifest.json").read_text())
+
+
+def test_warm_restart_skips_compile(tmp_path):
+    cache = tmp_path / "xla-cache"
+    cold = _run_sweep(tmp_path / "run1", cache)
+    # The cache dir was created and populated by the first process.
+    assert cold["compilation_cache_dir"] == str(cache)
+    assert any(cache.iterdir()), "persistent cache is empty after a cold run"
+
+    warm = _run_sweep(tmp_path / "run2", cache)
+    t_cold = cold["timings"]["first_cell_s"]
+    t_warm = warm["timings"]["first_cell_s"]
+    # Tiny-model compile dominates the cold first cell (seconds vs ~0.1s
+    # execution); a warm restart must be well under it.
+    assert t_warm < t_cold * 0.8, (t_cold, t_warm)

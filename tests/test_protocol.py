@@ -93,6 +93,69 @@ def test_filter_messages_for_model():
     assert len(dropped) == len(msgs) - 1
 
 
+GEMMA_STYLE_TEMPLATE = (
+    "{% for message in messages %}"
+    "{% if message['role'] == 'system' %}"
+    "{{ raise_exception('System role not supported') }}"
+    "{% endif %}"
+    "{{ '<start_of_turn>' + message['role'] + '\n' + message['content'] "
+    "+ '<end_of_turn>\n' }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<start_of_turn>model\n' }}{% endif %}"
+)
+
+
+def _write_gemma_style_tokenizer(path):
+    """A real HF fast tokenizer on disk whose chat template raises on system
+    roles (the Gemma-2 template behavior)."""
+    import json as _json
+
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {w: i for i, w in enumerate(
+        ["<unk>", "<pad>", "<eos>", "Trial", "researcher", "thought"]
+    )}
+    tok = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    path.mkdir(parents=True, exist_ok=True)
+    tok.save(str(path / "tokenizer.json"))
+    (path / "tokenizer_config.json").write_text(_json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "chat_template": GEMMA_STYLE_TEMPLATE,
+        "pad_token": "<pad>",
+        "eos_token": "<eos>",
+        "unk_token": "<unk>",
+    }))
+
+
+def test_system_role_probe_on_path_loaded_gemma_template(tmp_path):
+    """A Gemma-templated tokenizer loaded by PATH (model_name matches no
+    registry short name) must have its system turn dropped via the template
+    probe — not leak it into a template that raises on system roles."""
+    from introspective_awareness_tpu.models.tokenizer import HFTokenizer
+    from introspective_awareness_tpu.protocol.prompts import (
+        template_supports_system_role,
+    )
+
+    _write_gemma_style_tokenizer(tmp_path / "gemma_tok")
+    tok = HFTokenizer(str(tmp_path / "gemma_tok"))
+    assert template_supports_system_role(tok) is False
+    # cached on the instance after the first probe
+    assert tok._supports_system_role is False
+
+    rendered, start = render_trial_prompt(tok, str(tmp_path / "gemma_tok"), 2, "injection")
+    assert "system" not in rendered
+    assert "Trial 2" in rendered and start is not None
+
+    # ByteTokenizer renders any role: probe says supported, system turn kept.
+    bt = ByteTokenizer()
+    assert template_supports_system_role(bt) is True
+    msgs = build_trial_messages(1, "injection")
+    assert filter_messages_for_model(msgs, "somewhere/else", bt) == msgs
+
+
 def test_introspection_prompt_rendering():
     tok = ByteTokenizer()
     p = IntrospectionPrompt("sys", "user msg", prefill="Ok.")
