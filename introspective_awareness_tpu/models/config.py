@@ -12,7 +12,15 @@ flags here.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Mapping
+
+
+def _yarn_mscale(scale: float, mscale: float = 1.0) -> float:
+    """Yarn attention magnitude correction (HF ``yarn_get_mscale``)."""
+    if scale <= 1:
+        return 1.0
+    return 0.1 * mscale * math.log(scale) + 1.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -21,7 +29,10 @@ class RopeScaling:
 
     ``kind="llama3"`` applies Llama-3's frequency-dependent smoothing;
     ``kind="linear"`` divides all frequencies by ``factor`` (Gemma-3 global
-    layers use this with factor 8).
+    layers use this with factor 8); ``kind="yarn"`` is the NTK-by-parts
+    interpolation DeepSeek-V2/V3 use, with the cos/sin attention factor
+    inferred from mscale/mscale_all_dim (HF modeling_rope_utils
+    ``_compute_yarn_parameters``).
     """
 
     factor: float
@@ -29,6 +40,12 @@ class RopeScaling:
     low_freq_factor: float = 1.0
     high_freq_factor: float = 4.0
     original_max_position: int = 8192
+    # yarn-only knobs
+    beta_fast: float = 32.0
+    beta_slow: float = 1.0
+    mscale: float = 0.0  # 0 = unset
+    mscale_all_dim: float = 0.0
+    attention_factor: float | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,18 +85,73 @@ class ModelConfig:
     moe_mlp_hidden: int = 0
     # Gemma-3 uses a different rope theta for local (sliding) layers
     rope_theta_local: float | None = None
+    # --- MLA attention (DeepSeek V2/V2.5/V3, Kimi-K2) ----------------------
+    # Queries/keys split into a large no-rope part and a small shared-rope
+    # part; K/V are generated from a low-rank compressed stream that is also
+    # what the KV cache stores (reference compat target:
+    # model_utils.py:19-47,144-216 — the families it monkey-patches).
+    attn_type: str = "mha"  # "mha" | "mla"
+    q_lora_rank: int | None = None  # None = direct q projection (V2-Lite)
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
+    rope_interleave: bool = False  # DeepSeek pairs (2i, 2i+1) per rope freq
+    # --- DeepSeek-style MoE ------------------------------------------------
+    # "softmax_topk": Qwen3-MoE/Mixtral routing. "deepseek_v2": softmax scores
+    # with optional group-limited greedy top-k. "deepseek_v3": sigmoid scores
+    # + e_score_correction_bias, group top-2-sum selection.
+    moe_style: str = "softmax_topk"
+    moe_norm_topk_prob: bool = True
+    n_shared_experts: int = 0  # shared-expert MLP width = n * moe_mlp_hidden
+    first_k_dense: int = 0  # dense-MLP layers before the MoE trunk
+    routed_scaling_factor: float = 1.0
+    n_group: int = 1
+    topk_group: int = 1
+    moe_topk_method: str = "greedy"  # greedy | group_limited_greedy | noaux_tc
 
     @property
     def is_moe(self) -> bool:
         return self.n_experts > 0
 
     @property
+    def is_mla(self) -> bool:
+        return self.attn_type == "mla"
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def rope_dim(self) -> int:
+        """Width of the rotary tables (MLA ropes only the decoupled part)."""
+        return self.qk_rope_head_dim if self.is_mla else self.head_dim
+
+    @property
     def q_dim(self) -> int:
-        return self.n_heads * self.head_dim
+        return self.n_heads * (self.qk_head_dim if self.is_mla else self.head_dim)
+
+    @property
+    def o_dim(self) -> int:
+        """Attention-output width feeding wo."""
+        return self.n_heads * (self.v_head_dim if self.is_mla else self.head_dim)
 
     @property
     def kv_dim(self) -> int:
         return self.n_kv_heads * self.head_dim
+
+    @property
+    def cache_kv_heads(self) -> int:
+        return 1 if self.is_mla else self.n_kv_heads
+
+    @property
+    def cache_k_dim(self) -> int:
+        """MLA caches the compressed stream + shared rope key — the whole
+        point of the architecture (and ~n_heads x smaller than caching k)."""
+        return (
+            self.kv_lora_rank + self.qk_rope_head_dim
+            if self.is_mla else self.head_dim
+        )
 
     def layer_is_sliding(self, layer_idx: int) -> bool:
         """Host-side helper (tracing uses the precomputed per-layer array)."""
@@ -138,6 +210,20 @@ def config_from_hf(hf: Mapping[str, Any]) -> ModelConfig:
                 high_freq_factor=rs["high_freq_factor"],
                 original_max_position=rs["original_max_position_embeddings"],
             )
+        elif rope_type == "yarn":
+            rope_scaling = RopeScaling(
+                factor=rs["factor"],
+                kind="yarn",
+                original_max_position=rs.get(
+                    "original_max_position_embeddings",
+                    hf.get("max_position_embeddings", 8192),
+                ),
+                beta_fast=rs.get("beta_fast") or 32.0,
+                beta_slow=rs.get("beta_slow") or 1.0,
+                mscale=rs.get("mscale") or 0.0,
+                mscale_all_dim=rs.get("mscale_all_dim") or 0.0,
+                attention_factor=rs.get("attention_factor"),
+            )
         elif rope_type in ("linear", "default", None):
             if rs.get("factor", 1.0) != 1.0:
                 rope_scaling = RopeScaling(factor=rs["factor"], kind="linear")
@@ -167,6 +253,55 @@ def config_from_hf(hf: Mapping[str, Any]) -> ModelConfig:
         return ModelConfig(**common)
     if model_type == "qwen2":
         return ModelConfig(**common, qkv_bias=True)
+    if model_type == "mixtral":
+        # HF Mixtral routing = softmax over all experts, top-k, renormalize —
+        # exactly the softmax_topk path (BASELINE.json config #5).
+        return ModelConfig(
+            **common,
+            n_experts=hf["num_local_experts"],
+            n_experts_per_tok=hf["num_experts_per_tok"],
+            moe_mlp_hidden=hf["intermediate_size"],
+            moe_norm_topk_prob=True,  # Mixtral always renormalizes top-k
+            sliding_window=hf.get("sliding_window"),
+            # Mixtral's window (when set) applies to every layer; the pattern
+            # marks layer i sliding iff (i+1) % pattern != 0, so a pattern
+            # larger than any layer count means "all sliding".
+            sliding_window_pattern=1_000_000_000,
+        )
+    if model_type in ("deepseek_v2", "deepseek_v3", "kimi_k2"):
+        # MLA + (V2: softmax / V3: sigmoid+bias group-limited) MoE with a
+        # dense prefix and shared experts. Kimi-K2 ships the V3 architecture.
+        is_v3 = model_type != "deepseek_v2"
+        yarn = rope_scaling if rope_scaling and rope_scaling.kind == "yarn" else None
+        query_scale = (hf["qk_nope_head_dim"] + hf["qk_rope_head_dim"]) ** -0.5
+        if is_v3 and yarn and yarn.mscale_all_dim:
+            m = _yarn_mscale(yarn.factor, yarn.mscale_all_dim)
+            query_scale = query_scale * m * m
+        n_routed = hf.get("n_routed_experts") or 0
+        return ModelConfig(
+            **common,
+            attn_type="mla",
+            q_lora_rank=hf.get("q_lora_rank"),
+            kv_lora_rank=hf["kv_lora_rank"],
+            qk_nope_head_dim=hf["qk_nope_head_dim"],
+            qk_rope_head_dim=hf["qk_rope_head_dim"],
+            v_head_dim=hf["v_head_dim"],
+            rope_interleave=hf.get("rope_interleave", is_v3),
+            query_scale=query_scale,
+            n_experts=n_routed,
+            n_experts_per_tok=hf.get("num_experts_per_tok") or 0,
+            moe_mlp_hidden=hf.get("moe_intermediate_size") or 0,
+            moe_style="deepseek_v3" if is_v3 else "deepseek_v2",
+            moe_norm_topk_prob=hf.get("norm_topk_prob", False),
+            n_shared_experts=hf.get("n_shared_experts") or 0,
+            first_k_dense=hf.get("first_k_dense_replace", 0) if n_routed else 0,
+            routed_scaling_factor=hf.get("routed_scaling_factor", 1.0),
+            n_group=hf.get("n_group") or 1,
+            topk_group=hf.get("topk_group") or 1,
+            moe_topk_method=(
+                "noaux_tc" if is_v3 else hf.get("topk_method", "greedy")
+            ),
+        )
     if model_type == "qwen3":
         return ModelConfig(**common, use_qk_norm=True)
     if model_type == "qwen3_moe":
@@ -176,6 +311,7 @@ def config_from_hf(hf: Mapping[str, Any]) -> ModelConfig:
             n_experts=hf["num_experts"],
             n_experts_per_tok=hf["num_experts_per_tok"],
             moe_mlp_hidden=hf["moe_intermediate_size"],
+            moe_norm_topk_prob=hf.get("norm_topk_prob", False),
         )
     if model_type == "gemma2":
         return ModelConfig(

@@ -89,23 +89,38 @@ class CheckpointReader:
         self._handles.clear()
 
 
-def _hf_layer_names(cfg: ModelConfig) -> dict[str, Callable[[int], list[str]]]:
-    """Our stacked-layer key → HF tensor name(s) for layer i.
+def _hf_layer_names(
+    cfg: ModelConfig, moe: bool, reader: "CheckpointReader | None" = None
+) -> dict[str, Callable[[int], list[str]]]:
+    """Our stacked-layer key → HF tensor name(s) for layer i of one group.
 
     Multi-name entries (MoE experts) are stacked on a new leading dim.
     Families covered: llama/mistral, qwen2 (bias), qwen3 (+qk-norm),
-    qwen3_moe, gemma2/gemma3 (extra norms). HF reference naming per
-    ``transformers`` modeling files; the reference loads these same
-    checkpoints via AutoModel (model_utils.py:117).
+    qwen3_moe, gemma2/gemma3 (extra norms), mixtral (block_sparse_moe w1/w3/
+    w2), deepseek_v2/v3 + kimi (MLA projections, shared experts, router
+    bias). HF reference naming per ``transformers`` modeling files; the
+    reference loads these same checkpoints via AutoModel (model_utils.py:117).
     """
     p = "model.layers.{i}."
     names: dict[str, Any] = {
-        "wq": p + "self_attn.q_proj.weight",
-        "wk": p + "self_attn.k_proj.weight",
-        "wv": p + "self_attn.v_proj.weight",
-        "wo": p + "self_attn.o_proj.weight",
         "attn_norm": p + "input_layernorm.weight",
     }
+    if cfg.is_mla:
+        names["wkv_a"] = p + "self_attn.kv_a_proj_with_mqa.weight"
+        names["kv_a_norm"] = p + "self_attn.kv_a_layernorm.weight"
+        names["wkv_b"] = p + "self_attn.kv_b_proj.weight"
+        names["wo"] = p + "self_attn.o_proj.weight"
+        if cfg.q_lora_rank:
+            names["wq_a"] = p + "self_attn.q_a_proj.weight"
+            names["q_a_norm"] = p + "self_attn.q_a_layernorm.weight"
+            names["wq_b"] = p + "self_attn.q_b_proj.weight"
+        else:
+            names["wq"] = p + "self_attn.q_proj.weight"
+    else:
+        names["wq"] = p + "self_attn.q_proj.weight"
+        names["wk"] = p + "self_attn.k_proj.weight"
+        names["wv"] = p + "self_attn.v_proj.weight"
+        names["wo"] = p + "self_attn.o_proj.weight"
     if cfg.use_post_norms:  # Gemma-2/3 four-norm block
         names["post_attn_norm"] = p + "post_attention_layernorm.weight"
         names["mlp_norm"] = p + "pre_feedforward_layernorm.weight"
@@ -119,7 +134,20 @@ def _hf_layer_names(cfg: ModelConfig) -> dict[str, Callable[[int], list[str]]]:
     if cfg.use_qk_norm:
         names["q_norm"] = p + "self_attn.q_norm.weight"
         names["k_norm"] = p + "self_attn.k_norm.weight"
-    if cfg.is_moe:
+    # Mixtral's MoE block is named block_sparse_moe with w1/w3/w2 experts;
+    # probe the checkpoint to pick the scheme (config alone already told us
+    # the family, but probing keeps this robust to finetune re-exports).
+    mixtral = (
+        moe and reader is not None
+        and "model.layers.0.block_sparse_moe.gate.weight" in reader
+    )
+    if moe and mixtral:
+        bp = p + "block_sparse_moe."
+        names["router"] = bp + "gate.weight"
+        names["w_gate"] = [bp + f"experts.{e}.w1.weight" for e in range(cfg.n_experts)]
+        names["w_up"] = [bp + f"experts.{e}.w3.weight" for e in range(cfg.n_experts)]
+        names["w_down"] = [bp + f"experts.{e}.w2.weight" for e in range(cfg.n_experts)]
+    elif moe:
         names["router"] = p + "mlp.gate.weight"
         names["w_gate"] = [
             p + f"mlp.experts.{e}.gate_proj.weight" for e in range(cfg.n_experts)
@@ -130,6 +158,12 @@ def _hf_layer_names(cfg: ModelConfig) -> dict[str, Callable[[int], list[str]]]:
         names["w_down"] = [
             p + f"mlp.experts.{e}.down_proj.weight" for e in range(cfg.n_experts)
         ]
+        if cfg.moe_style == "deepseek_v3":
+            names["e_bias"] = p + "mlp.gate.e_score_correction_bias"
+        if cfg.n_shared_experts:
+            names["w_shared_gate"] = p + "mlp.shared_experts.gate_proj.weight"
+            names["w_shared_up"] = p + "mlp.shared_experts.up_proj.weight"
+            names["w_shared_down"] = p + "mlp.shared_experts.down_proj.weight"
     else:
         names["w_gate"] = p + "mlp.gate_proj.weight"
         names["w_up"] = p + "mlp.up_proj.weight"
@@ -137,7 +171,11 @@ def _hf_layer_names(cfg: ModelConfig) -> dict[str, Callable[[int], list[str]]]:
     return names
 
 # Linear weights stored [out, in] by HF; our einsums use [in, out].
-_TRANSPOSED = {"wq", "wk", "wv", "wo", "router", "w_gate", "w_up", "w_down"}
+_TRANSPOSED = {
+    "wq", "wk", "wv", "wo", "router", "w_gate", "w_up", "w_down",
+    "wq_a", "wq_b", "wkv_a", "wkv_b",
+    "w_shared_gate", "w_shared_up", "w_shared_down",
+}
 # Norm scales and biases are 1-D, taken as-is.
 
 
@@ -171,9 +209,9 @@ def load_params(
             arr, shax.logical_to_sharding(tuple(logical), mesh, rules)
         )
 
-    def read_stacked(key: str, template) -> np.ndarray:
+    def read_stacked(key: str, template, layer_range) -> np.ndarray:
         per_layer = []
-        for i in range(cfg.n_layers):
+        for i in layer_range:
             if isinstance(template, list):  # MoE: stack experts below layers
                 tensors = [reader.get(t.format(i=i)) for t in template]
                 t = np.stack(
@@ -190,11 +228,18 @@ def load_params(
         embed = reader.get("model.embed_tokens.weight")
         params: dict[str, Any] = {"embed": put(embed, axes["embed"])}
 
-        layer_axes = axes["layers"]
-        layers: dict[str, Any] = {}
-        for key, template in _hf_layer_names(cfg).items():
-            layers[key] = put(read_stacked(key, template), layer_axes[key])
-        params["layers"] = layers
+        kd = cfg.first_k_dense
+        groups = [("layers", range(kd, cfg.n_layers), cfg.is_moe)]
+        if kd:
+            groups.append(("dense_layers", range(kd), False))
+        for group_key, layer_range, moe in groups:
+            group_axes = axes[group_key]
+            stack: dict[str, Any] = {}
+            for key, template in _hf_layer_names(cfg, moe, reader).items():
+                stack[key] = put(
+                    read_stacked(key, template, layer_range), group_axes[key]
+                )
+            params[group_key] = stack
 
         params["final_norm"] = put(reader.get("model.norm.weight"), axes["final_norm"])
         if not cfg.tie_embeddings:

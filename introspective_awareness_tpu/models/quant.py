@@ -23,7 +23,12 @@ import jax.numpy as jnp
 # listed separately: int4 error on router logits can flip top-k expert
 # selection (bitsandbytes setups likewise skip gate/router modules), so it is
 # only ever quantized at 8-bit.
-QUANTIZABLE = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+QUANTIZABLE = {
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+    # MLA projections + DeepSeek shared experts
+    "wq_a", "wq_b", "wkv_a", "wkv_b",
+    "w_shared_gate", "w_shared_up", "w_shared_down",
+}
 QUANTIZABLE_8BIT_ONLY = {"router"}
 
 
@@ -92,13 +97,16 @@ def quantize_params(params: dict, bits: int = 8, dtype=jnp.bfloat16) -> dict:
     quantize in place → old buffers freed.
     """
     out = dict(params)
-    layers = dict(params["layers"])
-    for key in list(layers):
-        if key in QUANTIZABLE or key in QUANTIZABLE_8BIT_ONLY:
-            key_bits = 8 if key in QUANTIZABLE_8BIT_ONLY else bits
-            # Leading layer dim (and the expert dim for MoE weights) get
-            # per-slice scales so the layer scan slices them consistently.
-            batch_dims = layers[key].ndim - 2
-            layers[key] = quantize_tensor(layers[key], key_bits, dtype, batch_dims)
-    out["layers"] = layers
+    for group in ("layers", "dense_layers"):
+        if group not in params:
+            continue
+        layers = dict(params[group])
+        for key in list(layers):
+            if key in QUANTIZABLE or key in QUANTIZABLE_8BIT_ONLY:
+                key_bits = 8 if key in QUANTIZABLE_8BIT_ONLY else bits
+                # Leading layer dim (and the expert dim for MoE weights) get
+                # per-slice scales so the layer scan slices them consistently.
+                batch_dims = layers[key].ndim - 2
+                layers[key] = quantize_tensor(layers[key], key_bits, dtype, batch_dims)
+        out[group] = layers
     return out

@@ -38,18 +38,15 @@ PRE_QUANTIZED_MODELS = {
     "deepseek_v3",  # FineGrainedFP8
 }
 
-# Kept for registry parity with the reference, but not yet loadable: these use
-# MLA attention, which transformer.py does not implement. The loader refuses
-# them up front instead of failing mid-sweep.
-UNSUPPORTED_MODELS = {"deepseek_v3", "deepseek_v2.5", "deepseek_v2", "kimi_k2"}
+# Every registry family is loadable: llama/qwen2/qwen3(+moe)/gemma2/3 (MHA),
+# mixtral, and the MLA families (deepseek_v2/v2.5/v3, kimi_k2) via the
+# compressed-KV MLA block in transformer.py.
+UNSUPPORTED_MODELS: set[str] = set()
 
 
 def check_supported(model_name: str) -> None:
-    if model_name in UNSUPPORTED_MODELS:
-        raise NotImplementedError(
-            f"{model_name} uses MLA attention, not yet implemented in the JAX "
-            "decoder (supported families: llama, qwen2/3(+moe), gemma2/3)"
-        )
+    if model_name in UNSUPPORTED_MODELS:  # pragma: no cover - none currently
+        raise NotImplementedError(f"{model_name} is not supported")
 
 # Chat templates for these models have no system role; system messages are
 # dropped before rendering (reference detect_injected_thoughts.py:81-99).

@@ -86,9 +86,13 @@ class KVCache(NamedTuple):
 def init_cache(
     cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.float32
 ) -> KVCache:
+    """MHA caches per-head k/v; MLA caches one row of compressed-kv + shared
+    rope key per token (``v`` is unused and kept zero-width)."""
+    kvh, kd = cfg.cache_kv_heads, cfg.cache_k_dim
+    vd = 0 if cfg.is_mla else cfg.head_dim
     return KVCache(
-        k=jnp.zeros((cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim), dtype),
-        v=jnp.zeros((cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim), dtype),
+        k=jnp.zeros((cfg.n_layers, batch, max_len, kvh, kd), dtype),
+        v=jnp.zeros((cfg.n_layers, batch, max_len, kvh, vd), dtype),
         slot_mask=jnp.zeros((batch, max_len), jnp.bool_),
         positions=jnp.zeros((batch, max_len), jnp.int32),
         length=jnp.int32(0),
@@ -100,11 +104,19 @@ def init_cache(
 # ---------------------------------------------------------------------------
 
 
-def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32) -> dict:
-    """Random-init parameter pytree with stacked layers (leading dim L)."""
+def n_trunk_layers(cfg: ModelConfig) -> int:
+    """Layers in the main (stacked) group; the rest form the dense prefix
+    (DeepSeek ``first_k_dense_replace``)."""
+    return cfg.n_layers - cfg.first_k_dense
+
+
+def _init_layer_stack(
+    cfg: ModelConfig, key: jax.Array, L: int, moe: bool, dtype
+) -> dict:
+    """One scan-stacked layer group (attention per cfg.attn_type; MLP dense
+    or MoE per ``moe``)."""
     keys = iter(jax.random.split(key, 32))
-    H, L = cfg.hidden_size, cfg.n_layers
-    QD, KVD, M, V = cfg.q_dim, cfg.kv_dim, cfg.mlp_hidden, cfg.vocab_size
+    H, M = cfg.hidden_size, cfg.mlp_hidden
 
     def w(k, *shape, scale=None):
         scale = scale if scale is not None else (shape[-2] if len(shape) > 1 else H) ** -0.5
@@ -113,80 +125,151 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32) -> dict:
     norm_init = jnp.zeros if cfg.norm_scale_plus_one else jnp.ones
     layers: dict[str, Any] = {
         "attn_norm": norm_init((L, H), dtype),
-        "wq": w(next(keys), L, H, QD),
-        "wk": w(next(keys), L, H, KVD),
-        "wv": w(next(keys), L, H, KVD),
-        "wo": w(next(keys), L, QD, H),
         "mlp_norm": norm_init((L, H), dtype),
     }
-    if cfg.qkv_bias:
-        layers["bq"] = jnp.zeros((L, QD), dtype)
-        layers["bk"] = jnp.zeros((L, KVD), dtype)
-        layers["bv"] = jnp.zeros((L, KVD), dtype)
-    if cfg.use_qk_norm:
-        layers["q_norm"] = norm_init((L, cfg.head_dim), dtype)
-        layers["k_norm"] = norm_init((L, cfg.head_dim), dtype)
+    if cfg.is_mla:
+        R, NR = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+        layers["wkv_a"] = w(next(keys), L, H, R + NR)
+        layers["kv_a_norm"] = norm_init((L, R), dtype)
+        layers["wkv_b"] = w(
+            next(keys), L, R, cfg.n_heads * (cfg.qk_nope_head_dim + cfg.v_head_dim)
+        )
+        layers["wo"] = w(next(keys), L, cfg.o_dim, H)
+        if cfg.q_lora_rank:
+            layers["wq_a"] = w(next(keys), L, H, cfg.q_lora_rank)
+            layers["q_a_norm"] = norm_init((L, cfg.q_lora_rank), dtype)
+            layers["wq_b"] = w(next(keys), L, cfg.q_lora_rank, cfg.q_dim)
+        else:
+            layers["wq"] = w(next(keys), L, H, cfg.q_dim)
+    else:
+        QD, KVD = cfg.q_dim, cfg.kv_dim
+        layers["wq"] = w(next(keys), L, H, QD)
+        layers["wk"] = w(next(keys), L, H, KVD)
+        layers["wv"] = w(next(keys), L, H, KVD)
+        layers["wo"] = w(next(keys), L, QD, H)
+        if cfg.qkv_bias:
+            layers["bq"] = jnp.zeros((L, QD), dtype)
+            layers["bk"] = jnp.zeros((L, KVD), dtype)
+            layers["bv"] = jnp.zeros((L, KVD), dtype)
+        if cfg.use_qk_norm:
+            layers["q_norm"] = norm_init((L, cfg.head_dim), dtype)
+            layers["k_norm"] = norm_init((L, cfg.head_dim), dtype)
     if cfg.use_post_norms:
         layers["post_attn_norm"] = norm_init((L, H), dtype)
         layers["post_mlp_norm"] = norm_init((L, H), dtype)
-    if cfg.is_moe:
+    if moe:
         E, ME = cfg.n_experts, cfg.moe_mlp_hidden
         layers["router"] = w(next(keys), L, H, E)
         layers["w_gate"] = w(next(keys), L, E, H, ME)
         layers["w_up"] = w(next(keys), L, E, H, ME)
         layers["w_down"] = w(next(keys), L, E, ME, H)
+        if cfg.moe_style == "deepseek_v3":
+            layers["e_bias"] = jnp.zeros((L, E), jnp.float32)
+        if cfg.n_shared_experts:
+            MS = ME * cfg.n_shared_experts
+            layers["w_shared_gate"] = w(next(keys), L, H, MS)
+            layers["w_shared_up"] = w(next(keys), L, H, MS)
+            layers["w_shared_down"] = w(next(keys), L, MS, H)
     else:
         layers["w_gate"] = w(next(keys), L, H, M)
         layers["w_up"] = w(next(keys), L, H, M)
         layers["w_down"] = w(next(keys), L, M, H)
+    return layers
 
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32) -> dict:
+    """Random-init parameter pytree with stacked layers (leading dim L).
+
+    Models with a dense prefix before a MoE trunk (DeepSeek) get a second
+    stack ``dense_layers`` scanned before ``layers``."""
+    k_embed, k_head, k_trunk, k_dense = jax.random.split(key, 4)
+    H, V = cfg.hidden_size, cfg.vocab_size
+
+    def w(k, *shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] if len(shape) > 1 else H) ** -0.5
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    norm_init = jnp.zeros if cfg.norm_scale_plus_one else jnp.ones
     params = {
-        "embed": w(next(keys), V, H, scale=1.0),
-        "layers": layers,
+        "embed": w(k_embed, V, H, scale=1.0),
+        "layers": _init_layer_stack(
+            cfg, k_trunk, n_trunk_layers(cfg), cfg.is_moe, dtype
+        ),
         "final_norm": norm_init((H,), dtype),
     }
+    if cfg.first_k_dense:
+        params["dense_layers"] = _init_layer_stack(
+            cfg, k_dense, cfg.first_k_dense, False, dtype
+        )
     if not cfg.tie_embeddings:
-        params["lm_head"] = w(next(keys), H, V)
+        params["lm_head"] = w(k_head, H, V)
     return params
 
 
-def param_logical_axes(cfg: ModelConfig) -> dict:
-    """Logical-axis pytree mirroring ``init_params`` (feeds ShardingRules)."""
-    LA, E, H, M, V = shax.LAYERS, shax.EXPERT, shax.EMBED, shax.MLP, shax.VOCAB
+def _layer_stack_axes(cfg: ModelConfig, moe: bool) -> dict:
+    LA, E, H, M = shax.LAYERS, shax.EXPERT, shax.EMBED, shax.MLP
     HEADS, KVH = shax.HEADS, shax.KV_HEADS
     layers: dict[str, Any] = {
         "attn_norm": (LA, H),
-        # q/k/v/o: shard the head (output) dim over 'model'
-        "wq": (LA, H, HEADS),
-        "wk": (LA, H, KVH),
-        "wv": (LA, H, KVH),
-        "wo": (LA, HEADS, H),
         "mlp_norm": (LA, H),
     }
-    if cfg.qkv_bias:
-        layers["bq"] = (LA, HEADS)
-        layers["bk"] = (LA, KVH)
-        layers["bv"] = (LA, KVH)
-    if cfg.use_qk_norm:
-        layers["q_norm"] = (LA, None)
-        layers["k_norm"] = (LA, None)
+    if cfg.is_mla:
+        # The compressed-kv projections are small and head-less; shard the
+        # per-head fan-outs (wkv_b, wq/wq_b output, wo input) over 'model'.
+        layers["wkv_a"] = (LA, H, None)
+        layers["kv_a_norm"] = (LA, None)
+        layers["wkv_b"] = (LA, None, HEADS)
+        layers["wo"] = (LA, HEADS, H)
+        if cfg.q_lora_rank:
+            layers["wq_a"] = (LA, H, None)
+            layers["q_a_norm"] = (LA, None)
+            layers["wq_b"] = (LA, None, HEADS)
+        else:
+            layers["wq"] = (LA, H, HEADS)
+    else:
+        # q/k/v/o: shard the head (output) dim over 'model'
+        layers["wq"] = (LA, H, HEADS)
+        layers["wk"] = (LA, H, KVH)
+        layers["wv"] = (LA, H, KVH)
+        layers["wo"] = (LA, HEADS, H)
+        if cfg.qkv_bias:
+            layers["bq"] = (LA, HEADS)
+            layers["bk"] = (LA, KVH)
+            layers["bv"] = (LA, KVH)
+        if cfg.use_qk_norm:
+            layers["q_norm"] = (LA, None)
+            layers["k_norm"] = (LA, None)
     if cfg.use_post_norms:
         layers["post_attn_norm"] = (LA, H)
         layers["post_mlp_norm"] = (LA, H)
-    if cfg.is_moe:
+    if moe:
         layers["router"] = (LA, H, None)
         layers["w_gate"] = (LA, E, H, M)
         layers["w_up"] = (LA, E, H, M)
         layers["w_down"] = (LA, E, M, H)
+        if cfg.moe_style == "deepseek_v3":
+            layers["e_bias"] = (LA, None)
+        if cfg.n_shared_experts:
+            layers["w_shared_gate"] = (LA, H, M)
+            layers["w_shared_up"] = (LA, H, M)
+            layers["w_shared_down"] = (LA, M, H)
     else:
         layers["w_gate"] = (LA, H, M)
         layers["w_up"] = (LA, H, M)
         layers["w_down"] = (LA, M, H)
+    return layers
+
+
+def param_logical_axes(cfg: ModelConfig) -> dict:
+    """Logical-axis pytree mirroring ``init_params`` (feeds ShardingRules)."""
+    H, V = shax.EMBED, shax.VOCAB
     axes = {
         "embed": (V, H),
-        "layers": layers,
+        "layers": _layer_stack_axes(cfg, cfg.is_moe),
         "final_norm": (H,),
     }
+    if cfg.first_k_dense:
+        axes["dense_layers"] = _layer_stack_axes(cfg, False)
     if not cfg.tie_embeddings:
         axes["lm_head"] = (H, V)
     return axes
@@ -215,12 +298,31 @@ def mlp_act(x: jax.Array, cfg: ModelConfig) -> jax.Array:
 
 def rope_inv_freq(cfg: ModelConfig, local: bool = False) -> jax.Array:
     theta = cfg.rope_theta_local if (local and cfg.rope_theta_local) else cfg.rope_theta
-    d = cfg.head_dim
+    d = cfg.rope_dim
     inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
     rs = cfg.rope_scaling
     if rs is not None and not local and rs.kind == "linear":
         # Gemma-3-style linear scaling on global layers.
         inv = inv / rs.factor
+    elif rs is not None and not local and rs.kind == "yarn":
+        # NTK-by-parts (DeepSeek): interpolate low frequencies by 1/factor,
+        # keep high frequencies, ramp between (HF _compute_yarn_parameters).
+        import math
+
+        def corr_dim(rot):
+            return (
+                d * math.log(rs.original_max_position / (rot * 2 * math.pi))
+            ) / (2 * math.log(theta))
+
+        low = max(math.floor(corr_dim(rs.beta_fast)), 0)
+        high = min(math.ceil(corr_dim(rs.beta_slow)), d - 1)
+        ramp = jnp.clip(
+            (jnp.arange(d // 2, dtype=jnp.float32) - low) / max(high - low, 0.001),
+            0,
+            1,
+        )
+        extrapolation_factor = 1.0 - ramp
+        inv = (inv / rs.factor) * ramp + inv * extrapolation_factor
     elif rs is not None and not local:
         # Llama-3 frequency-dependent scaling (matches HF rope_type="llama3").
         low_wl = rs.original_max_position / rs.low_freq_factor
@@ -238,11 +340,28 @@ def rope_inv_freq(cfg: ModelConfig, local: bool = False) -> jax.Array:
     return inv
 
 
-def rope_cos_sin(positions: jax.Array, inv_freq: jax.Array):
+def rope_attention_factor(cfg: ModelConfig) -> float:
+    """Yarn cos/sin magnitude factor (HF ``attention_factor`` inference)."""
+    rs = cfg.rope_scaling
+    if rs is None or rs.kind != "yarn":
+        return 1.0
+    if rs.attention_factor is not None:
+        return rs.attention_factor
+    import math
+
+    def mscale(scale, m=1.0):
+        return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+    if rs.mscale and rs.mscale_all_dim:
+        return mscale(rs.factor, rs.mscale) / mscale(rs.factor, rs.mscale_all_dim)
+    return mscale(rs.factor)
+
+
+def rope_cos_sin(positions: jax.Array, inv_freq: jax.Array, factor: float = 1.0):
     """positions [B, S] → cos/sin [B, S, D] (HF half-rotation convention)."""
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
     angles = jnp.concatenate([angles, angles], axis=-1)  # [B, S, D]
-    return jnp.cos(angles), jnp.sin(angles)
+    return jnp.cos(angles) * factor, jnp.sin(angles) * factor
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
@@ -253,6 +372,19 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return (
         x.astype(jnp.float32) * cos[:, :, None, :] + rotated.astype(jnp.float32) * sin[:, :, None, :]
     ).astype(x.dtype)
+
+
+def apply_rope_interleaved(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """DeepSeek convention: adjacent pairs (2i, 2i+1) rotate by freq i
+    (HF ``apply_rotary_emb`` complex form / ``apply_rotary_pos_emb_interleave``
+    — both pair the same components, so scores match either)."""
+    half = x.shape[-1] // 2
+    c = cos[:, :, None, :half]  # rope_cos_sin duplicates angles; take freq i
+    s = sin[:, :, None, :half]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
 
 
 def _attention(
@@ -276,7 +408,45 @@ def _attention(
     scores = jnp.where(allowed[:, None, None, :, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
-    return out.reshape(B, S, NH, D)
+    return out.reshape(B, S, NH, v.shape[-1])  # v dim may differ from D (MLA)
+
+
+def _attention_2part(
+    q: jax.Array,  # [B, S, NH, D]
+    k_old: jax.Array,  # [B, T, KVH, D] cached slots (none of them current)
+    v_old: jax.Array,
+    m_old: jax.Array,  # [B, S, T]
+    k_new: jax.Array,  # [B, S, KVH, D] the current chunk
+    v_new: jax.Array,
+    m_new: jax.Array,  # [B, S, S]
+    cfg: ModelConfig,
+) -> jax.Array:
+    """Decode attention over (cached slots ⊕ current chunk) with one shared
+    softmax. The chunk's k/v never enter the big cache buffer inside the
+    layer scan — only these S new rows leave the scan as outputs, so a decode
+    step writes S rows instead of rewriting the whole [B, T] cache."""
+    B, S, NH, D = q.shape
+    KVH = k_old.shape[2]
+    groups = NH // KVH
+    qg = q.reshape(B, S, KVH, groups, D)
+    scale = cfg.query_scale if cfg.query_scale is not None else D**-0.5
+
+    def part(k, m):
+        s = jnp.einsum(
+            "bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32
+        ) * scale
+        if cfg.attn_logit_softcap:
+            cap = cfg.attn_logit_softcap
+            s = cap * jnp.tanh(s / cap)
+        return jnp.where(m[:, None, None, :, :], s, _NEG_INF)
+
+    scores = jnp.concatenate([part(k_old, m_old), part(k_new, m_new)], axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    T = k_old.shape[1]
+    out = jnp.einsum("bkgst,btkd->bskgd", probs[..., :T], v_old) + jnp.einsum(
+        "bkgst,btkd->bskgd", probs[..., T:], v_new
+    )
+    return out.reshape(B, S, NH, v_old.shape[-1])
 
 
 # ---------------------------------------------------------------------------
@@ -330,14 +500,25 @@ def forward(
     if cfg.embed_scale:
         h = (h.astype(jnp.float32) * (cfg.hidden_size**0.5)).astype(dtype)
 
-    # Rope tables (global + optional local-theta variant for Gemma-3).
-    cos_g, sin_g = rope_cos_sin(positions, rope_inv_freq(cfg, local=False))
+    # Rope tables (global + optional local-theta variant for Gemma-3). The
+    # yarn attention factor scales cos/sin (DeepSeek; 1.0 otherwise).
+    cos_g, sin_g = rope_cos_sin(
+        positions, rope_inv_freq(cfg, local=False), rope_attention_factor(cfg)
+    )
     if cfg.rope_theta_local:
         cos_l, sin_l = rope_cos_sin(positions, rope_inv_freq(cfg, local=True))
     else:
         cos_l, sin_l = cos_g, sin_g
 
     # --- attention visibility -------------------------------------------------
+    # Chunk-internal visibility (prefill / extraction / the new tokens of a
+    # decode step) is causal-within-chunk; cached slots are all strictly
+    # earlier, so the cache part of a decode step is gated by the OLD
+    # slot_mask alone. The scan emits only the chunk's new k/v rows — the
+    # full cache buffer is written once, in place, after the scan (per-step
+    # full-cache rewrites were the decode bandwidth bottleneck).
+    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    allowed = causal[None, :, :] & attn_mask[:, None, :].astype(jnp.bool_)
     if use_cache:
         assert cache is not None
         length = cache.length
@@ -345,30 +526,27 @@ def forward(
             cache.slot_mask, attn_mask.astype(jnp.bool_), (0, length)
         )
         new_positions = lax.dynamic_update_slice(cache.positions, positions, (0, length))
-        if is_prefill:
-            # Empty cache: attend over just the current chunk; k/v still land
-            # in the full-length buffers below.
-            causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
-            allowed = causal[None, :, :] & attn_mask[:, None, :].astype(jnp.bool_)
-            k_positions = positions
-        else:
-            T = cache.k.shape[2]
-            q_slots = length + jnp.arange(S)  # [S]
-            causal = jnp.arange(T)[None, :] <= q_slots[:, None]  # [S, T]
-            allowed = causal[None, :, :] & new_slot_mask[:, None, :]  # [B, S, T]
-            k_positions = new_positions
+        allowed_old = jnp.broadcast_to(
+            cache.slot_mask[:, None, :], (B, S, cache.k.shape[2])
+        )
     else:
-        causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
-        allowed = causal[None, :, :] & attn_mask[:, None, :].astype(jnp.bool_)
-        k_positions = positions
         new_slot_mask = new_positions = None
         length = None
+        allowed_old = None
 
     if cfg.sliding_window is not None:
-        delta = positions[:, :, None] - k_positions[:, None, :]  # [B, S, T]
+        delta = positions[:, :, None] - positions[:, None, :]  # [B, S, S]
         allowed_local = allowed & (delta < cfg.sliding_window) & (delta >= 0)
+        if allowed_old is not None:
+            delta_old = positions[:, :, None] - cache.positions[:, None, :]
+            allowed_old_local = (
+                allowed_old & (delta_old < cfg.sliding_window) & (delta_old >= 0)
+            )
+        else:
+            allowed_old_local = None
     else:
         allowed_local = allowed
+        allowed_old_local = allowed_old
 
     # Per-layer flags/ids as scan xs (runtime operands, never recompile).
     layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
@@ -394,10 +572,8 @@ def forward(
 
     plus1 = cfg.norm_scale_plus_one
 
-    def block(h, xs):
-        lp, layer_id, sliding = xs["p"], xs["layer_id"], xs["sliding"]
-
-        x = rms_norm(h, lp["attn_norm"], cfg.rms_eps, plus1)
+    def mha_attention(x, lp, xs, sliding):
+        """Standard GQA attention; returns (attn [B,S,NH,D], cache writes)."""
         q = jnp.einsum("bsh,hq->bsq", x, W(lp["wq"]))
         k = jnp.einsum("bsh,hk->bsk", x, W(lp["wk"]))
         v = jnp.einsum("bsh,hk->bsk", x, W(lp["wv"]))
@@ -415,14 +591,6 @@ def forward(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-        if use_cache:
-            k_full = lax.dynamic_update_slice(xs["ck"], k, (0, length, 0, 0))
-            v_full = lax.dynamic_update_slice(xs["cv"], v, (0, length, 0, 0))
-            # Prefill attends over the chunk only; decode over the full cache.
-            k_att, v_att = (k, v) if is_prefill else (k_full, v_full)
-        else:
-            k_att, v_att = k, v
-
         backend = jax.default_backend()
         use_flash = (
             cfg.attn_impl == "flash" and S > 1 and (not use_cache or is_prefill)
@@ -431,7 +599,18 @@ def forward(
             # path instead of failing at lowering time.
             and backend in ("tpu", "cpu")
         )
-        if use_flash:
+        amask = jnp.where(sliding, allowed_local, allowed) if cfg.sliding_window else allowed
+        if use_cache and not is_prefill:
+            # Cached slots ⊕ current chunk under one softmax; only the chunk's
+            # rows leave the scan.
+            amask_old = (
+                jnp.where(sliding, allowed_old_local, allowed_old)
+                if cfg.sliding_window else allowed_old
+            )
+            attn = _attention_2part(
+                q, xs["ck"], xs["cv"], amask_old, k, v, amask, cfg
+            )
+        elif use_flash:
             # Pallas fused attention over the current chunk; causal +
             # left-padding + per-layer sliding window are position-space
             # operands (ops/attention.py). Decode and the non-prefill cached
@@ -447,16 +626,108 @@ def forward(
                 interpret=backend == "cpu",
             )
         else:
-            amask = jnp.where(sliding, allowed_local, allowed) if cfg.sliding_window else allowed
-            attn = _attention(q, k_att, v_att, amask, cfg)
-        attn = jnp.einsum("bsq,qh->bsh", attn.reshape(B, S, cfg.q_dim), W(lp["wo"]))
+            attn = _attention(q, k, v, amask, cfg)
+        return attn, k, v
+
+    def mla_attention(x, lp, xs):
+        """MLA (DeepSeek V2/V3, Kimi-K2; HF modeling_deepseek_v3.py:330-447):
+        low-rank compressed kv + a single shared rope key per token. The cache
+        stores only the (normed) compressed row — prefill materializes
+        per-head k/v for the chunk; decode runs the weight-absorbed form
+        directly against the compressed cache."""
+        R, NR = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+        NH, ND, VD = cfg.n_heads, cfg.qk_nope_head_dim, cfg.v_head_dim
+
+        if cfg.q_lora_rank:
+            qa = jnp.einsum("bsh,hr->bsr", x, W(lp["wq_a"]))
+            qa = rms_norm(qa, lp["q_a_norm"], cfg.rms_eps, plus1)
+            q = jnp.einsum("bsr,rq->bsq", qa, W(lp["wq_b"]))
+        else:
+            q = jnp.einsum("bsh,hq->bsq", x, W(lp["wq"]))
+        q = q.reshape(B, S, NH, ND + NR)
+        q_nope, q_rot = q[..., :ND], q[..., ND:]
+
+        ckv = jnp.einsum("bsh,hr->bsr", x, W(lp["wkv_a"]))  # [B,S,R+NR]
+        c = rms_norm(ckv[..., :R], lp["kv_a_norm"], cfg.rms_eps, plus1)
+        k_rot = ckv[:, :, None, R:]  # [B,S,1,NR] — shared across heads
+
+        rope_fn = apply_rope_interleaved if cfg.rope_interleave else apply_rope
+        q_rot = rope_fn(q_rot, cos_g, sin_g)
+        k_rot = rope_fn(k_rot, cos_g, sin_g)
+
+        # The cache row: (normed compressed kv, shared roped key). [B,S,1,R+NR]
+        row = jnp.concatenate([c, k_rot[:, :, 0, :]], -1)[:, :, None, :]
+
+        scale = cfg.query_scale if cfg.query_scale is not None else cfg.qk_head_dim**-0.5
+        if use_cache and not is_prefill:
+            # Absorbed decode: scores = (W_kb^T q_nope)·c + q_rot·k_rot, and
+            # the output re-expands through W_vb — identical math to
+            # materializing k/v, with HBM traffic R+NR per token instead of
+            # NH*(qk_head+v_head). Cached slots and the current chunk share
+            # one softmax; only the chunk's rows leave the scan.
+            wkv_b = W(lp["wkv_b"]).reshape(R, NH, ND + VD)
+            wk_b, wv_b = wkv_b[..., :ND], wkv_b[..., ND:]
+            cc_old = xs["ck"][:, :, 0, :R]
+            kr_old = xs["ck"][:, :, 0, R:]
+            q_abs = jnp.einsum(
+                "bsnd,rnd->bsnr", q_nope, wk_b, preferred_element_type=jnp.float32
+            ).astype(x.dtype)
+
+            def part(cc, kr, m):
+                s = (
+                    jnp.einsum("bsnr,btr->bnst", q_abs, cc,
+                               preferred_element_type=jnp.float32)
+                    + jnp.einsum("bsnd,btd->bnst", q_rot, kr,
+                                 preferred_element_type=jnp.float32)
+                ) * scale
+                return jnp.where(m[:, None, :, :], s, _NEG_INF)
+
+            k_rot_chunk = k_rot[:, :, 0, :]
+            scores = jnp.concatenate(
+                [
+                    part(cc_old, kr_old, allowed_old),
+                    part(c, k_rot_chunk, allowed),
+                ],
+                axis=-1,
+            )
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            T = cc_old.shape[1]
+            ctx = jnp.einsum("bnst,btr->bsnr", probs[..., :T], cc_old) + jnp.einsum(
+                "bnst,btr->bsnr", probs[..., T:], c
+            )
+            attn = jnp.einsum("bsnr,rnd->bsnd", ctx, wv_b)  # [B,S,NH,VD]
+        else:
+            # Prefill / extraction: per-head k,v for the current chunk only.
+            kv = jnp.einsum("bsr,rq->bsq", c, W(lp["wkv_b"]))
+            kv = kv.reshape(B, S, NH, ND + VD)
+            k_nope, v = kv[..., :ND], kv[..., ND:]
+            k = jnp.concatenate(
+                [k_nope, jnp.broadcast_to(k_rot, (B, S, NH, NR))], -1
+            )
+            qq = jnp.concatenate([q_nope, q_rot], -1)
+            attn = _attention(qq, k, v, allowed, cfg)
+        return attn, row
+
+    def block(h, xs, *, moe):
+        lp, layer_id, sliding = xs["p"], xs["layer_id"], xs["sliding"]
+
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_eps, plus1)
+        if cfg.is_mla:
+            attn, k_row = mla_attention(x, lp, xs)
+            v_row = None
+        else:
+            attn, k_row, v_row = mha_attention(x, lp, xs, sliding)
+        attn = jnp.einsum("bsq,qh->bsh", attn.reshape(B, S, cfg.o_dim), W(lp["wo"]))
         if cfg.use_post_norms:
             attn = rms_norm(attn, lp["post_attn_norm"], cfg.rms_eps, plus1)
         h = h + attn
 
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps, plus1)
-        if cfg.is_moe:
-            mlp = _moe_mlp(x, lp, cfg)
+        if moe:
+            if cfg.moe_style == "softmax_topk":
+                mlp = _moe_mlp(x, lp, cfg)
+            else:
+                mlp = _deepseek_moe(x, lp, cfg)
         else:
             gate = jnp.einsum("bsh,hm->bsm", x, W(lp["w_gate"]))
             up = jnp.einsum("bsh,hm->bsm", x, W(lp["w_up"]))
@@ -471,27 +742,58 @@ def forward(
 
         ys = {}
         if use_cache:
-            ys["ck"], ys["cv"] = k_full, v_full
+            ys["k_row"] = k_row  # [B, S, KVH, D] — the chunk's new slots only
+            if not cfg.is_mla:
+                ys["v_row"] = v_row
         if capture:
             ys["cap"] = h[batch_ix, capture_pos, :]  # [B, H]
         return h, ys
 
-    xs = {"p": params["layers"], "layer_id": layer_ids, "sliding": is_sliding}
-    if use_cache:
-        xs["ck"], xs["cv"] = cache.k, cache.v
+    # Layer groups: the optional dense prefix (DeepSeek first_k_dense) scans
+    # before the main trunk; per-layer ids/flags and cache slices follow the
+    # global layer numbering, so steering/capture are group-agnostic.
+    kd = cfg.first_k_dense if "dense_layers" in params else 0
+    groups = []
+    if kd:
+        groups.append((params["dense_layers"], 0, kd, False))
+    groups.append((params["layers"], kd, cfg.n_layers, cfg.is_moe))
 
-    h, ys = lax.scan(block, h, xs)
+    read_cache = use_cache and not is_prefill  # prefill never reads old slots
+    all_ys = []
+    for stack, lo, hi, moe in groups:
+        xs = {"p": stack, "layer_id": layer_ids[lo:hi], "sliding": is_sliding[lo:hi]}
+        if read_cache:
+            xs["ck"] = cache.k[lo:hi]
+            if not cfg.is_mla:
+                xs["cv"] = cache.v[lo:hi]
+        h, ys = lax.scan(partial(block, moe=moe), h, xs)
+        all_ys.append(ys)
+
+    def cat(key):
+        parts = [y[key] for y in all_ys]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
 
     new_cache = None
     if use_cache:
+        # One in-place row write per step — the donated cache buffer is
+        # updated, never rewritten wholesale inside the layer scan.
+        new_k = lax.dynamic_update_slice(
+            cache.k, cat("k_row").astype(cache.k.dtype), (0, 0, length, 0, 0)
+        )
+        if cfg.is_mla:
+            new_v = cache.v
+        else:
+            new_v = lax.dynamic_update_slice(
+                cache.v, cat("v_row").astype(cache.v.dtype), (0, 0, length, 0, 0)
+            )
         new_cache = KVCache(
-            k=ys["ck"],
-            v=ys["cv"],
+            k=new_k,
+            v=new_v,
             slot_mask=new_slot_mask,
             positions=new_positions,
             length=length + S,
         )
-    captured = ys.get("cap") if capture else None  # [L, B, H]
+    captured = cat("cap") if capture else None  # [L, B, H]
 
     logits = None
     if logits_mode != "none":
@@ -525,16 +827,78 @@ def _moe_mlp(x: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
     )
     probs = jax.nn.softmax(logits, axis=-1)
     topv, topi = lax.top_k(probs, cfg.n_experts_per_tok)  # [B,S,K]
-    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    if cfg.moe_norm_topk_prob:
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
     combine = jnp.sum(
         jax.nn.one_hot(topi, cfg.n_experts, dtype=x.dtype) * topv[..., None].astype(x.dtype),
         axis=2,
     )  # [B, S, E]
+    return _experts_combine(x, lp, cfg, combine)
+
+
+def _experts_combine(x, lp, cfg, combine):
+    """Dense-combine expert execution shared by every MoE style: all experts
+    run (EP shards them over the mesh ``expert`` axis); the combine matrix
+    [B,S,E] selects and weights."""
     gate = jnp.einsum("bsh,ehm->ebsm", x, W(lp["w_gate"]))
     up = jnp.einsum("bsh,ehm->ebsm", x, W(lp["w_up"]))
     act = mlp_act(gate, cfg) * up
     eo = jnp.einsum("ebsm,emh->ebsh", act, W(lp["w_down"]))
     return jnp.einsum("ebsh,bse->bsh", eo, combine)
+
+
+def _deepseek_moe(x: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
+    """DeepSeek V2/V3 MoE: scored routing with optional group limits, scaled
+    top-k weights, plus always-on shared experts.
+
+    V2 (HF modeling_deepseek_v2.py:45-93): softmax scores; topk_method
+    "greedy" or "group_limited_greedy" (per-group max). V3
+    (modeling_deepseek_v3.py:110-153): sigmoid scores + e_score_correction
+    bias for *selection only*, groups ranked by their top-2 sum, weights
+    gathered from the unbiased scores.
+    """
+    B, S, E = x.shape[0], x.shape[1], cfg.n_experts
+    K = cfg.n_experts_per_tok
+    logits = jnp.einsum(
+        "bsh,he->bse", x.astype(jnp.float32), W(lp["router"]).astype(jnp.float32)
+    )
+    if cfg.moe_style == "deepseek_v3":
+        scores = jax.nn.sigmoid(logits)
+        choice = scores + lp["e_bias"]
+    else:
+        scores = jax.nn.softmax(logits, axis=-1)
+        choice = scores
+
+    if cfg.moe_topk_method in ("group_limited_greedy", "noaux_tc") and cfg.n_group > 1:
+        G = cfg.n_group
+        grouped = choice.reshape(B, S, G, E // G)
+        if cfg.moe_style == "deepseek_v3":
+            group_rank = jnp.sum(lax.top_k(grouped, 2)[0], axis=-1)  # top-2 sum
+        else:
+            group_rank = jnp.max(grouped, axis=-1)
+        _, top_groups = lax.top_k(group_rank, cfg.topk_group)  # [B,S,topk_group]
+        group_mask = jnp.sum(
+            jax.nn.one_hot(top_groups, G, dtype=jnp.float32), axis=2
+        )  # [B,S,G]
+        choice = (grouped * group_mask[..., None]).reshape(B, S, E)
+
+    _, topi = lax.top_k(choice, K)  # selection by (possibly biased) choice
+    weights = jnp.take_along_axis(scores, topi, axis=-1)  # unbiased weights
+    if cfg.moe_norm_topk_prob:
+        weights = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-20)
+    weights = weights * cfg.routed_scaling_factor
+    combine = jnp.sum(
+        jax.nn.one_hot(topi, E, dtype=x.dtype) * weights[..., None].astype(x.dtype),
+        axis=2,
+    )
+    routed = _experts_combine(x, lp, cfg, combine)
+    if not cfg.n_shared_experts:
+        return routed
+
+    gate = jnp.einsum("bsh,hm->bsm", x, W(lp["w_shared_gate"]))
+    up = jnp.einsum("bsh,hm->bsm", x, W(lp["w_shared_up"]))
+    shared = jnp.einsum("bsm,mh->bsh", mlp_act(gate, cfg) * up, W(lp["w_shared_down"]))
+    return routed + shared
 
 
 def make_positions(attn_mask: jax.Array) -> jax.Array:

@@ -165,6 +165,102 @@ def test_sharded_load_matches_unsharded(tmp_path, mesh8):
     )
 
 
+def test_mixtral_parity(tmp_path):
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=48, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        sliding_window=None,
+    )
+    torch.manual_seed(8)
+    model = transformers.MixtralForCausalLM(hf_cfg)
+    _save_hf_model(tmp_path, model)
+    _compare_logits(tmp_path, model, json.load(open(tmp_path / "config.json")))
+
+
+def test_deepseek_v2_parity(tmp_path):
+    """MLA with q-LoRA + group-limited softmax routing + shared experts +
+    dense prefix (reference compat families, model_utils.py:19-47)."""
+    hf_cfg = transformers.DeepseekV2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        moe_intermediate_size=32, num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=4, q_lora_rank=48, kv_lora_rank=32,
+        qk_rope_head_dim=8, qk_nope_head_dim=16, v_head_dim=16,
+        n_routed_experts=4, n_shared_experts=2, num_experts_per_tok=2,
+        topk_method="group_limited_greedy", n_group=2, topk_group=1,
+        first_k_dense_replace=1, routed_scaling_factor=1.0,
+        norm_topk_prob=False, max_position_embeddings=256,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(9)
+    model = transformers.DeepseekV2ForCausalLM(hf_cfg)
+    _save_hf_model(tmp_path, model)
+    _compare_logits(tmp_path, model, json.load(open(tmp_path / "config.json")))
+
+
+def test_deepseek_v2_lite_parity(tmp_path):
+    """V2-Lite shape: no q-LoRA, greedy top-k."""
+    hf_cfg = transformers.DeepseekV2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        moe_intermediate_size=32, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, q_lora_rank=None, kv_lora_rank=32,
+        qk_rope_head_dim=8, qk_nope_head_dim=16, v_head_dim=16,
+        n_routed_experts=4, n_shared_experts=1, num_experts_per_tok=2,
+        topk_method="greedy", first_k_dense_replace=1,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    torch.manual_seed(10)
+    model = transformers.DeepseekV2ForCausalLM(hf_cfg)
+    _save_hf_model(tmp_path, model)
+    _compare_logits(tmp_path, model, json.load(open(tmp_path / "config.json")))
+
+
+def _tiny_v3_config(**kw):
+    base = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        moe_intermediate_size=32, num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=4, q_lora_rank=48, kv_lora_rank=32,
+        qk_rope_head_dim=8, qk_nope_head_dim=16, v_head_dim=16,
+        n_routed_experts=4, n_shared_experts=1, num_experts_per_tok=2,
+        n_group=2, topk_group=1, first_k_dense_replace=1,
+        routed_scaling_factor=2.5, norm_topk_prob=True,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    base.update(kw)
+    return transformers.DeepseekV3Config(**base)
+
+
+def test_deepseek_v3_parity(tmp_path):
+    """V3/Kimi-K2 architecture: sigmoid router + e_score_correction_bias,
+    group top-2-sum selection, interleaved rope."""
+    hf_cfg = _tiny_v3_config()
+    torch.manual_seed(11)
+    model = transformers.DeepseekV3ForCausalLM(hf_cfg)
+    # Exercise a non-zero correction bias (checkpoints carry trained values).
+    with torch.no_grad():
+        for layer in model.model.layers[1:]:
+            layer.mlp.gate.e_score_correction_bias.uniform_(-0.2, 0.2)
+    _save_hf_model(tmp_path, model)
+    _compare_logits(tmp_path, model, json.load(open(tmp_path / "config.json")))
+
+
+def test_deepseek_v3_yarn_parity(tmp_path):
+    """Yarn rope scaling with DeepSeek's mscale-adjusted softmax scale."""
+    hf_cfg = _tiny_v3_config(
+        num_hidden_layers=2,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 4.0, "beta_fast": 32.0,
+            "beta_slow": 1.0, "mscale": 1.0, "mscale_all_dim": 1.0,
+            "original_max_position_embeddings": 64,
+        },
+    )
+    torch.manual_seed(12)
+    model = transformers.DeepseekV3ForCausalLM(hf_cfg)
+    _save_hf_model(tmp_path, model)
+    _compare_logits(tmp_path, model, json.load(open(tmp_path / "config.json")))
+
+
 def test_gemma3_parity(tmp_path):
     hf_cfg = transformers.Gemma3TextConfig(
         vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=6,
