@@ -286,7 +286,11 @@ def config_from_hf(hf: Mapping[str, Any]) -> ModelConfig:
             qk_nope_head_dim=hf["qk_nope_head_dim"],
             qk_rope_head_dim=hf["qk_rope_head_dim"],
             v_head_dim=hf["v_head_dim"],
-            rope_interleave=hf.get("rope_interleave", is_v3),
+            # HF DeepseekV2 *always* ropes complex pairs (2i,2i+1) — its
+            # apply_rotary_emb uses view_as_complex — while V3 gates on
+            # config.rope_interleave (default True). So interleave is the
+            # correct default for the whole family, not just V3.
+            rope_interleave=hf.get("rope_interleave", True),
             query_scale=query_scale,
             n_experts=n_routed,
             n_experts_per_tok=hf.get("num_experts_per_tok") or 0,
