@@ -2,11 +2,21 @@
 
 Replaces the reference's ``AutoModelForCausalLM.from_pretrained(
 device_map="auto")`` path (model_utils.py:61-136): weights stream from the
-checkpoint's safetensors shards directly into our scan-stacked layout, and
-each stacked parameter is ``device_put`` with its logical-axis sharding — no
-single device (or the host, beyond one stacked parameter at a time)
-materializes the full model, which is what 70B+ checkpoints require
-(SURVEY.md §7.4.4).
+checkpoint's safetensors shards directly into our scan-stacked layout **one
+layer at a time** — each per-layer tensor (for MoE stacks, the layer's
+[E, ...] expert block) is ``device_put`` with its sharding and written into
+the device-resident stacked buffer with a jitted ``dynamic_update_slice`` on
+the never-sharded layer dim. Host staging never exceeds a single layer's
+tensor, which is what 70B+/405B checkpoints require (SURVEY.md §7.4.4);
+bf16 checkpoints stay bf16 on host (no f32 upcast).
+
+FineGrainedFP8 pre-quantized checkpoints (DeepSeek-V3, Kimi-K2 — reference
+``PRE_QUANTIZED_MODELS``, model_utils.py:50-53, loaded there through
+transformers' FP8 integration at model_utils.py:117) store float8_e4m3fn
+weights plus per-block ``weight_scale_inv`` tensors; the reader dequantizes
+block-wise on read (w_f32 = w_fp8 * scale_inv per block, matching
+``transformers/integrations/finegrained_fp8.py``'s use of the scale as the
+``scale_b`` multiplier).
 
 Name mapping is per-family but small because the decoder families share the
 HF naming scheme; weights are transposed from HF's [out, in] to the [in, out]
@@ -15,12 +25,14 @@ einsum layout used by ``transformer.forward``.
 
 from __future__ import annotations
 
+import functools
 import json
 from pathlib import Path
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import ml_dtypes
 import numpy as np
 
 from introspective_awareness_tpu.models.config import ModelConfig, config_from_hf
@@ -31,7 +43,12 @@ from introspective_awareness_tpu.parallel import sharding as shax
 
 
 class CheckpointReader:
-    """Random access to tensors across a checkpoint's safetensors shards."""
+    """Random access to tensors across a checkpoint's safetensors shards.
+
+    Tensors come back as numpy arrays in their stored dtype (bf16 via
+    ml_dtypes — no f32 upcast on host). FP8 block-quantized tensors are
+    dequantized to f32 using their sidecar ``weight_scale_inv``.
+    """
 
     def __init__(self, ckpt_dir: Path):
         from safetensors import safe_open
@@ -63,14 +80,24 @@ class CheckpointReader:
                 self.weight_map = {
                     k[len("language_model."):]: v for k, v in prefixed.items()
                 }
+        # FineGrainedFP8 block size from the checkpoint's quantization config
+        # (HF writes quantization_config.weight_block_size, default 128x128).
+        self.fp8_block: tuple[int, int] | None = None
+        config_path = self.dir / "config.json"
+        if config_path.exists():
+            with open(config_path) as f:
+                qc = json.load(f).get("quantization_config") or {}
+            if qc.get("quant_method") == "fp8":
+                self.fp8_block = tuple(qc.get("weight_block_size") or (128, 128))
         self._handles: dict[str, Any] = {}
 
     def __contains__(self, name: str) -> bool:
         return name in self.weight_map
 
-    def get(self, name: str) -> np.ndarray:
+    def _raw(self, name: str) -> np.ndarray:
         # torch framework handles every checkpoint dtype incl. bf16/fp8
-        # (numpy's safetensors backend cannot represent bf16).
+        # (numpy's safetensors backend cannot represent them); bitcast views
+        # carry the payload into numpy without a host upcast.
         import torch
 
         file = self.weight_map[name]
@@ -79,9 +106,53 @@ class CheckpointReader:
                 self.dir / file, framework="pt"
             ).__enter__()
         t = self._handles[file].get_tensor(name)
+        if t.dtype == torch.bfloat16:
+            return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+        if t.dtype == torch.float8_e4m3fn:
+            return t.view(torch.uint8).numpy().view(ml_dtypes.float8_e4m3fn)
+        if t.dtype == torch.float8_e5m2:
+            return t.view(torch.uint8).numpy().view(ml_dtypes.float8_e5m2)
         if t.dtype not in (torch.float32, torch.float64, torch.float16):
             t = t.float()
         return t.numpy()
+
+    def get(self, name: str) -> np.ndarray:
+        arr = self._raw(name)
+        if arr.dtype in (
+            np.dtype(ml_dtypes.float8_e4m3fn), np.dtype(ml_dtypes.float8_e5m2)
+        ):
+            if name + "_scale_inv" in self.weight_map:  # FineGrainedFP8 blocks
+                return self._dequant_fp8(arr, self._raw(name + "_scale_inv"))
+            for suffix in ("_scale", "_scale_inv"):  # per-tensor scale
+                if name + suffix in self.weight_map:
+                    scale = np.asarray(self._raw(name + suffix), np.float32)
+                    if scale.size == 1:
+                        return arr.astype(np.float32) * float(scale.reshape(()))
+            raise ValueError(
+                f"fp8 tensor {name!r} has no weight_scale_inv sidecar; "
+                "loading the raw payload would produce unscaled garbage "
+                "weights (FineGrainedFP8 checkpoints store per-block scales)"
+            )
+        return arr
+
+    def _dequant_fp8(self, w: np.ndarray, scale_inv: np.ndarray) -> np.ndarray:
+        """Blockwise dequant: w_f32[i, j] = w_fp8[i, j] * scale_inv[i//b0, j//b1].
+
+        scale_inv has shape [ceil(out/b0), ceil(in/b1)] and multiplies the
+        fp8 payload (transformers FP8Linear passes it as scale_b)."""
+        b0, b1 = self.fp8_block or (128, 128)
+        out_dim, in_dim = w.shape
+        scale = np.asarray(scale_inv, np.float32)
+        expect = (-(-out_dim // b0), -(-in_dim // b1))
+        if scale.shape != expect:
+            raise ValueError(
+                f"weight_scale_inv shape {scale.shape} does not match block "
+                f"size {(b0, b1)} for a {w.shape} tensor (expected {expect}); "
+                "check quantization_config.weight_block_size"
+            )
+        scale = np.repeat(scale, b0, axis=0)[:out_dim]
+        scale = np.repeat(scale, b1, axis=1)[:, :in_dim]
+        return w.astype(np.float32) * scale
 
     def close(self) -> None:
         for h in self._handles.values():
@@ -179,6 +250,31 @@ _TRANSPOSED = {
 # Norm scales and biases are 1-D, taken as-is.
 
 
+@functools.lru_cache(maxsize=1)
+def _set_layer():
+    """Jitted write of one layer's tensor into the stacked device buffer.
+
+    Only the (never-sharded) leading layer dim takes a runtime index, so the
+    GSPMD partitioner keeps the update local to each shard — a dynamic index
+    on a *sharded* dim would force a resharding gather. Donation keeps device
+    peak at one buffer (CPU's runtime ignores donation; skip it there to
+    avoid a warning per compile)."""
+    donate = () if jax.default_backend() == "cpu" else (0,)
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def set_layer(buf, x, i):
+        return jax.lax.dynamic_update_index_in_dim(buf, x, i, 0)
+
+    return set_layer
+
+
+@functools.lru_cache(maxsize=None)
+def _zeros_executable(shape: tuple, dtype, sharding):
+    """Cached device-side zeros builder (shape-identical parameters — e.g.
+    the many 1-D norm stacks — share one compile)."""
+    return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
+
+
 def load_params(
     ckpt_dir: Path | str,
     cfg: ModelConfig,
@@ -189,40 +285,62 @@ def load_params(
 ) -> dict:
     """Read a checkpoint directory into the stacked-params pytree.
 
-    With ``mesh``, every parameter lands sharded per its logical axes; the
-    host peak is one stacked parameter (the layer stack of a single weight),
-    freed before the next is read.
+    With ``mesh``, every parameter lands sharded per its logical axes.
+    Stacked parameters are built by streaming one layer (one expert, for MoE
+    expert stacks) at a time into a device-resident buffer, so the host peak
+    is a single layer's tensor — not the layer stack — regardless of model
+    size (SURVEY.md §7.4.4; contrast the reference's whole-model accelerate
+    load, model_utils.py:107).
     """
     reader = reader or CheckpointReader(Path(ckpt_dir))
     rules = rules or ShardingRules()
     axes = param_logical_axes(cfg)
+    dt = np.dtype(dtype)
+    set_layer = _set_layer()
+
+    def sharding_of(logical: tuple):
+        if mesh is None:
+            return None
+        return shax.logical_to_sharding(tuple(logical), mesh, rules)
 
     def put(arr: np.ndarray, logical: tuple) -> jax.Array:
         # Cast on HOST (ml_dtypes covers bf16), then device_put with the
         # target sharding — each device receives only its shard. jnp.asarray
-        # first would commit the full stacked parameter to device 0, which
-        # OOMs exactly for the 70B+ case this loader exists for.
-        arr = np.asarray(arr).astype(np.dtype(dtype))
-        if mesh is None:
-            return jnp.asarray(arr)
-        return jax.device_put(
-            arr, shax.logical_to_sharding(tuple(logical), mesh, rules)
-        )
+        # first would commit the full parameter to device 0, which OOMs
+        # exactly for the 70B+ case this loader exists for.
+        arr = np.asarray(arr).astype(dt, copy=False)
+        s = sharding_of(logical)
+        return jnp.asarray(arr) if s is None else jax.device_put(arr, s)
 
-    def read_stacked(key: str, template, layer_range) -> np.ndarray:
-        per_layer = []
-        for i in layer_range:
-            if isinstance(template, list):  # MoE: stack experts below layers
-                tensors = [reader.get(t.format(i=i)) for t in template]
-                t = np.stack(
-                    [x.T if key in _TRANSPOSED else x for x in tensors], axis=0
-                )
-            else:
-                t = reader.get(template.format(i=i))
-                if key in _TRANSPOSED:
-                    t = t.T
-            per_layer.append(t)
-        return np.stack(per_layer, axis=0)
+    def device_zeros(shape: tuple, logical: tuple) -> jax.Array:
+        # Allocate the stacked buffer on device(s); a host-side np.zeros
+        # would page in the full stack during the transfer.
+        return _zeros_executable(shape, dt, sharding_of(logical))()
+
+    def read_one(key: str, name: str) -> np.ndarray:
+        t = reader.get(name)
+        if key in _TRANSPOSED:
+            t = t.T
+        return np.asarray(t).astype(dt, copy=False)
+
+    def read_layer(key: str, template, i: int) -> np.ndarray:
+        """One layer's tensor — for MoE expert stacks, the [E, ...] block
+        (per-expert HF tensors assembled on host). This block IS the host
+        peak; the stacked [L, ...] parameter never materializes on host."""
+        if isinstance(template, list):
+            return np.stack([read_one(key, t.format(i=i)) for t in template])
+        return read_one(key, template.format(i=i))
+
+    def stream_stacked(key: str, template, layer_range, logical: tuple) -> jax.Array:
+        logical = tuple(logical)
+        first = read_layer(key, template, layer_range[0])
+        buf = device_zeros((len(layer_range),) + first.shape, logical)
+        slice_sharding = sharding_of(logical[1:])
+        for j, i in enumerate(layer_range):
+            x = first if j == 0 else read_layer(key, template, i)
+            x = x if slice_sharding is None else jax.device_put(x, slice_sharding)
+            buf = set_layer(buf, x, j)
+        return buf
 
     try:
         embed = reader.get("model.embed_tokens.weight")
@@ -234,12 +352,10 @@ def load_params(
             groups.append(("dense_layers", range(kd), False))
         for group_key, layer_range, moe in groups:
             group_axes = axes[group_key]
-            stack: dict[str, Any] = {}
-            for key, template in _hf_layer_names(cfg, moe, reader).items():
-                stack[key] = put(
-                    read_stacked(key, template, layer_range), group_axes[key]
-                )
-            params[group_key] = stack
+            params[group_key] = {
+                key: stream_stacked(key, template, layer_range, group_axes[key])
+                for key, template in _hf_layer_names(cfg, moe, reader).items()
+            }
 
         params["final_norm"] = put(reader.get("model.norm.weight"), axes["final_norm"])
         if not cfg.tie_embeddings:
