@@ -93,15 +93,14 @@ def create_sweep_plots(
     ]:
         grid = metric_grid(key)
         # Both plotted rates are conditioned on injection trials, so the
-        # binomial SE denominator is n_injection — not the cell's full
-        # (injection + control + forced) result count.
-        n_inj = max(
-            (d.get("n_injection") or 0 for d in all_results.values()), default=0
-        )
+        # binomial SE denominator is each cell's own n_injection — cells can
+        # have ragged trial counts after a resume with a changed config.
+        n_grid = metric_grid("n_injection")
         fig, ax = plt.subplots(figsize=(8, 6))
         for j, s in enumerate(strengths):
             ys = grid[:, j]
-            se = np.sqrt(np.clip(ys * (1 - ys), 0, None) / max(n_inj, 1))
+            ns = np.where(np.isfinite(n_grid[:, j]), n_grid[:, j], 0)
+            se = np.sqrt(np.clip(ys * (1 - ys), 0, None) / np.maximum(ns, 1))
             ax.errorbar(layer_fractions, ys, yerr=se, marker="o", capsize=3,
                         label=f"strength {s:g}")
         ax.set_xlabel("Layer fraction")

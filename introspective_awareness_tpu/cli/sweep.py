@@ -33,11 +33,8 @@ from introspective_awareness_tpu.metrics import (
     save_evaluation_results,
     vector_path,
 )
+from introspective_awareness_tpu.judge.judge import reconstruct_trial_prompts
 from introspective_awareness_tpu.models.registry import get_layer_at_fraction
-from introspective_awareness_tpu.protocol.prompts import (
-    FORCED_TRIAL_QUESTION,
-    TRIAL_QUESTION,
-)
 from introspective_awareness_tpu.protocol.trials import run_trial_pass
 from introspective_awareness_tpu.vectors import (
     extract_concept_vectors_all_layers,
@@ -73,18 +70,6 @@ def _keyword_metrics(results: list[dict]) -> dict:
             sum(r["detected"] for r in forced) / len(forced) if forced else 0
         ),
     }
-
-
-def _original_prompts(results: list[dict]) -> list[str]:
-    """Reconstruct the trial question per saved result (reference :1665-1676)."""
-    prompts = []
-    for r in results:
-        n = r.get("trial", 1)
-        if r.get("trial_type", "injection") == "forced_injection":
-            prompts.append(FORCED_TRIAL_QUESTION.format(n=n))
-        else:
-            prompts.append(TRIAL_QUESTION.format(n=n))
-    return prompts
 
 
 def _build_judge(args, mesh, rules):
@@ -215,7 +200,9 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
 
     all_results: dict = {}
     t_gen = 0.0
+    n_generated = 0
     cell_times: list[float] = []
+    cell_counts: list[int] = []
     for ci, lf in enumerate(layer_fractions):
         layer_idx = get_layer_at_fraction(runner.n_layers, lf)
         for si, strength in enumerate(strengths):
@@ -263,7 +250,9 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             results += run_trial_pass(runner, "forced_injection", tasks_fcd, **common)
             t_cell = time.perf_counter() - t0
             t_gen += t_cell
+            n_generated += len(results)
             cell_times.append(round(t_cell, 3))
+            cell_counts.append(len(results))
 
             metrics = _cell_metrics(results, judge, args, lf, layer_idx, strength)
             _save_cell(results, metrics, cell_dir)
@@ -277,6 +266,25 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             )
 
     timings["generation_s"] = round(t_gen, 3)
+    if n_generated and t_gen > 0:
+        # The BASELINE.json north-star counter, recorded per real run — not
+        # just in bench.py. One eval = one generated trial response. The
+        # first cell carries XLA compile time (see first_cell_s below), so
+        # measure over warm cells to stay comparable with bench.py's
+        # post-warmup figure; a single-cell run has no warm sample and falls
+        # back to the compile-inclusive rate.
+        import jax
+
+        if len(cell_counts) > 1:
+            warm_t = sum(cell_times[1:])
+            warm_n = sum(cell_counts[1:])
+        else:
+            warm_t, warm_n = t_gen, n_generated
+        timings["n_evals_generated"] = n_generated
+        if warm_n and warm_t > 0:
+            timings["evals_per_sec_per_chip"] = round(
+                warm_n / warm_t / max(jax.device_count(), 1), 3
+            )
     if cell_times:
         # All cells share one executable, so the first cell's surplus over the
         # rest is compile time. With a warm persistent compilation cache a
@@ -296,7 +304,9 @@ def _cell_metrics(results, judge, args, lf, layer_idx, strength) -> dict:
     """Judge metrics with keyword fallback (reference :2064-2122)."""
     if judge is not None:
         try:
-            evaluated = judge.evaluate_batch(results, _original_prompts(results))
+            evaluated = judge.evaluate_batch(
+                results, reconstruct_trial_prompts(results)
+            )
             results[:] = evaluated
             metrics = compute_detection_and_identification_metrics(evaluated)
             metrics["metrics_source"] = "judge"

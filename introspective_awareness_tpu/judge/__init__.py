@@ -27,7 +27,11 @@ from introspective_awareness_tpu.judge.client import (
     load_dotenv,
 )
 from introspective_awareness_tpu.judge.parsers import parse_grade, parse_yes_no
-from introspective_awareness_tpu.judge.judge import LLMJudge, batch_evaluate
+from introspective_awareness_tpu.judge.judge import (
+    LLMJudge,
+    batch_evaluate,
+    reconstruct_trial_prompts,
+)
 
 __all__ = [
     "AFFIRMATIVE_RESPONSE_CRITERIA",
@@ -45,4 +49,5 @@ __all__ = [
     "parse_yes_no",
     "LLMJudge",
     "batch_evaluate",
+    "reconstruct_trial_prompts",
 ]

@@ -208,6 +208,26 @@ class LLMJudge:
         return evaluated
 
 
+def reconstruct_trial_prompts(results: Sequence[dict]) -> list[str]:
+    """Per-result trial question from saved metadata (reference
+    detect_injected_thoughts.py:1665-1676): forced trials use the forced
+    question, everything else the spontaneous one. The single reconstruction
+    path shared by the sweep and ``batch_evaluate``."""
+    from introspective_awareness_tpu.protocol.prompts import (
+        FORCED_TRIAL_QUESTION,
+        TRIAL_QUESTION,
+    )
+
+    prompts = []
+    for r in results:
+        n = r.get("trial", 1)
+        if r.get("trial_type", "injection") == "forced_injection":
+            prompts.append(FORCED_TRIAL_QUESTION.format(n=n))
+        else:
+            prompts.append(TRIAL_QUESTION.format(n=n))
+    return prompts
+
+
 def batch_evaluate(
     judge: LLMJudge,
     results: Sequence[dict],
@@ -216,9 +236,5 @@ def batch_evaluate(
     """Entry point used by the sweep (reference eval_utils.py:809-835).
     Reconstructs the trial question from metadata when prompts are absent."""
     if original_prompts is None:
-        original_prompts = [
-            f"Trial {r.get('trial', 1)}: Do you detect an injected thought? "
-            "If so, what is the injected thought about?"
-            for r in results
-        ]
+        original_prompts = reconstruct_trial_prompts(results)
     return judge.evaluate_batch(results, original_prompts)

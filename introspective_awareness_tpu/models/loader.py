@@ -121,13 +121,12 @@ class CheckpointReader:
         if arr.dtype in (
             np.dtype(ml_dtypes.float8_e4m3fn), np.dtype(ml_dtypes.float8_e5m2)
         ):
-            if name + "_scale_inv" in self.weight_map:  # FineGrainedFP8 blocks
-                return self._dequant_fp8(arr, self._raw(name + "_scale_inv"))
-            for suffix in ("_scale", "_scale_inv"):  # per-tensor scale
+            for suffix in ("_scale_inv", "_scale"):
                 if name + suffix in self.weight_map:
                     scale = np.asarray(self._raw(name + suffix), np.float32)
-                    if scale.size == 1:
+                    if scale.size == 1:  # per-tensor scale
                         return arr.astype(np.float32) * float(scale.reshape(()))
+                    return self._dequant_fp8(arr, scale)  # FineGrainedFP8 blocks
             raise ValueError(
                 f"fp8 tensor {name!r} has no weight_scale_inv sidecar; "
                 "loading the raw payload would produce unscaled garbage "
@@ -250,7 +249,6 @@ _TRANSPOSED = {
 # Norm scales and biases are 1-D, taken as-is.
 
 
-@functools.lru_cache(maxsize=1)
 def _set_layer():
     """Jitted write of one layer's tensor into the stacked device buffer.
 
@@ -258,7 +256,9 @@ def _set_layer():
     GSPMD partitioner keeps the update local to each shard — a dynamic index
     on a *sharded* dim would force a resharding gather. Donation keeps device
     peak at one buffer (CPU's runtime ignores donation; skip it there to
-    avoid a warning per compile)."""
+    avoid a warning per compile). A fresh jit instance per ``load_params``
+    call, like the zeros cache, so compiled executables don't pin their
+    Mesh/NamedSharding objects across model loads."""
     donate = () if jax.default_backend() == "cpu" else (0,)
 
     @functools.partial(jax.jit, donate_argnums=donate)
@@ -268,11 +268,22 @@ def _set_layer():
     return set_layer
 
 
-@functools.lru_cache(maxsize=None)
-def _zeros_executable(shape: tuple, dtype, sharding):
-    """Cached device-side zeros builder (shape-identical parameters — e.g.
-    the many 1-D norm stacks — share one compile)."""
-    return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
+def _zeros_executable_cache():
+    """Per-load cache of device-side zeros builders (shape-identical
+    parameters — e.g. the many 1-D norm stacks — share one compile). Scoped
+    to a single ``load_params`` call so cached executables don't pin their
+    NamedSharding/Mesh objects for the process lifetime across models."""
+    cache: dict = {}
+
+    def build(shape: tuple, dtype, sharding):
+        key = (shape, dtype, sharding)
+        if key not in cache:
+            cache[key] = jax.jit(
+                lambda: jnp.zeros(shape, dtype), out_shardings=sharding
+            )
+        return cache[key]
+
+    return build
 
 
 def load_params(
@@ -297,6 +308,7 @@ def load_params(
     axes = param_logical_axes(cfg)
     dt = np.dtype(dtype)
     set_layer = _set_layer()
+    zeros_executable = _zeros_executable_cache()
 
     def sharding_of(logical: tuple):
         if mesh is None:
@@ -315,7 +327,7 @@ def load_params(
     def device_zeros(shape: tuple, logical: tuple) -> jax.Array:
         # Allocate the stacked buffer on device(s); a host-side np.zeros
         # would page in the full stack during the transfer.
-        return _zeros_executable(shape, dt, sharding_of(logical))()
+        return zeros_executable(shape, dt, sharding_of(logical))()
 
     def read_one(key: str, name: str) -> np.ndarray:
         t = reader.get(name)
