@@ -8,7 +8,13 @@ tokens per trial — the exact workload of the reference's sweep inner loop
 model_utils.py:687-879), with the Python-hook hot loop replaced by one
 compiled prefill + decode program.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Sweeps the batch size (decode is weight-bandwidth-bound, so batch amortizes
+the per-step weight read) and an int8-quantized variant (halves weight
+traffic), reports the best config as the headline metric, and prints a
+modeled HBM-utilization figure to keep the number honest against the chip's
+roofline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 ``vs_baseline`` is null — the reference publishes no throughput numbers
 (BASELINE.md: "no timing/throughput numbers").
 """
@@ -26,50 +32,30 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+# Peak HBM bandwidth by device kind (GB/s); None → utilization not reported.
+_PEAK_HBM_GBPS = {
+    "TPU v5 lite": 819.0,  # v5e
+    "TPU v5e": 819.0,
+    "TPU v4": 1228.0,
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1640.0,  # v6e / Trillium
+}
+
+
+def _peak_hbm_gbps() -> float | None:
     import jax
 
-    from introspective_awareness_tpu.models.config import ModelConfig, tiny_config
-    from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
-    from introspective_awareness_tpu.models.transformer import init_params
-    from introspective_awareness_tpu.runtime.runner import ModelRunner
+    kind = jax.devices()[0].device_kind
+    for prefix, peak in _PEAK_HBM_GBPS.items():
+        if kind.startswith(prefix):
+            return peak
+    return None
 
-    backend = jax.default_backend()
-    n_chips = jax.device_count()
-    on_tpu = backend not in ("cpu",)
-    log(f"backend={backend} devices={n_chips}")
 
-    if on_tpu:
-        # Llama-3.2-1B-shaped (tied embeddings, GQA 32/8, 16 layers).
-        cfg = ModelConfig(
-            vocab_size=128256,
-            hidden_size=2048,
-            n_layers=16,
-            n_heads=32,
-            n_kv_heads=8,
-            head_dim=64,
-            mlp_hidden=8192,
-            rope_theta=500000.0,
-            tie_embeddings=True,
-        )
-        batch, max_new, iters = 32, 100, 3
-        dtype = jax.numpy.bfloat16
-    else:  # CPU smoke fallback so the bench still parses off-TPU
-        cfg = tiny_config(n_layers=4)
-        batch, max_new, iters = 8, 32, 2
-        dtype = jax.numpy.float32
-
-    tok = ByteTokenizer()
-    t0 = time.perf_counter()
-    params = init_params(cfg, jax.random.key(0), dtype=dtype)
-    jax.block_until_ready(params)
-    log(f"init {sum(x.size for x in jax.tree.leaves(params))/1e9:.2f}B params "
-        f"in {time.perf_counter()-t0:.1f}s")
-
-    runner = ModelRunner(params, cfg, tok, model_name="bench-llama1b-shape")
-
-    # The 4-turn introspection protocol prompt (reference steering_utils.py:208-252),
-    # byte-tokenized; per-prompt steering from the "Trial" position onward.
+def _build_workload(cfg, tok, batch: int):
+    """The 4-turn introspection protocol prompt (reference
+    steering_utils.py:208-252), byte-tokenized; per-prompt steering vectors
+    from the "Trial" position onward."""
     preamble = (
         "I am an interpretability researcher studying transformer-based language "
         "models like you. I have access to the internals of your neural network. "
@@ -87,6 +73,59 @@ def main() -> None:
     rng = np.random.default_rng(0)
     vecs = rng.normal(size=(batch, cfg.hidden_size)).astype(np.float32) * 5.0
     starts = [len(tok.encode(p)) - 60 for p in prompts]
+    return prompts, vecs, starts
+
+
+def _token_stats(runner, cfg, prompts, vecs, starts, max_new: int) -> dict:
+    """Generate once at the token level and return id statistics.
+
+    The ByteTokenizer cannot decode ids >= 256, so a decoded ``sample:``
+    string proves nothing on the 128k-vocab bench model. Token-id statistics
+    do: real sampling at temp 1.0 over random-init logits must produce mostly
+    non-pad, diverse ids; all-pad output would mean generation is broken.
+    """
+    import jax.numpy as jnp
+
+    from introspective_awareness_tpu.runtime.generate import (
+        GenSpec,
+        generate_tokens,
+    )
+
+    ids, mask, lens, B = runner._prep(prompts)
+    S = ids.shape[1]
+    starts_padded = np.asarray(S - lens + np.asarray(starts), np.int32)
+    spec = GenSpec(
+        rng=runner._next_key(123),
+        temperature=jnp.float32(1.0),
+        steer_layer=jnp.int32(int(cfg.n_layers * 0.6)),
+        steer_strength=jnp.float32(4.0),
+        steer_vectors=jnp.asarray(np.pad(vecs, ((0, ids.shape[0] - B), (0, 0)))),
+        steer_start=jnp.asarray(np.pad(starts_padded, (0, ids.shape[0] - B))),
+        eos_ids=jnp.asarray(list(runner.tokenizer.eos_ids), jnp.int32),
+        pad_id=jnp.int32(runner.tokenizer.pad_id),
+    )
+    tokens = np.asarray(
+        generate_tokens(
+            runner.params, cfg, ids, mask, spec, max_new_tokens=max_new
+        )
+    )[:B]
+    pad = int(runner.tokenizer.pad_id)
+    nonpad = tokens != pad
+    first = tokens[:, 0]
+    return {
+        "nonpad_frac": float(nonpad.mean()),
+        "distinct_ids": int(len(np.unique(tokens[nonpad]))) if nonpad.any() else 0,
+        # Rows carry different steering vectors, so their outputs must differ;
+        # identical rows would mean per-prompt steering is not reaching the
+        # forward pass.
+        "distinct_rows_by_first_token": int(len(np.unique(first))),
+        "prompt_len": int(S),
+        "n_generated_tokens": int(nonpad.sum()),
+    }
+
+
+def _timed_config(runner, cfg, tok, batch, max_new, iters, label) -> dict:
+    prompts, vecs, starts = _build_workload(cfg, tok, batch)
 
     def run(seed):
         return runner.generate_batch_with_multi_steering(
@@ -102,25 +141,168 @@ def main() -> None:
 
     t0 = time.perf_counter()
     run(0)  # compile + first run
-    log(f"warmup (incl. compile) {time.perf_counter()-t0:.1f}s")
+    warm = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     for i in range(iters):
-        out = run(i + 1)
+        run(i + 1)
     dt = time.perf_counter() - t0
     evals = batch * iters
-    evals_per_sec_chip = evals / dt / n_chips
-    tok_per_sec = evals * max_new / dt
-    log(f"{evals} steered evals in {dt:.2f}s -> "
-        f"{evals_per_sec_chip:.3f} evals/s/chip, {tok_per_sec:.0f} gen tok/s")
-    log(f"sample: {out[0][:80]!r}")
+    import jax
+
+    r = {
+        "label": label,
+        "batch": batch,
+        "evals_per_sec_chip": evals / dt / jax.device_count(),
+        "gen_tok_per_sec": evals * max_new / dt,
+        "decode_steps_per_sec": iters * max_new / dt,
+        "warmup_s": round(warm, 2),
+        "timed_s": round(dt, 2),
+    }
+    log(
+        f"  [{label}] batch={batch}: {evals} evals in {dt:.2f}s -> "
+        f"{r['evals_per_sec_chip']:.1f} evals/s/chip, "
+        f"{r['gen_tok_per_sec']:.0f} tok/s (warmup {warm:.1f}s)"
+    )
+    return r
+
+
+def _hbm_model(runner, cfg, batch, prompt_len, max_new) -> float:
+    """Modeled HBM bytes read per decode step: every parameter once + the
+    full KV-cache buffer (the decode attention reads all T slots each step
+    regardless of validity)."""
+    import jax
+
+    weight_bytes = sum(x.nbytes for x in jax.tree.leaves(runner.params))
+    T = prompt_len + max_new
+    kv_bytes = (
+        cfg.n_layers * batch * T * cfg.cache_kv_heads
+        * (cfg.cache_k_dim + (0 if cfg.is_mla else cfg.head_dim))
+        * np.dtype(np.float16).itemsize  # bf16 cache
+    )
+    return float(weight_bytes + kv_bytes)
+
+
+def main() -> None:
+    import jax
+
+    from introspective_awareness_tpu.models.config import ModelConfig, tiny_config
+    from introspective_awareness_tpu.models.quant import quantize_params
+    from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+    from introspective_awareness_tpu.models.transformer import init_params
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    backend = jax.default_backend()
+    n_chips = jax.device_count()
+    on_tpu = backend not in ("cpu",)
+    log(f"backend={backend} devices={n_chips} "
+        f"kind={jax.devices()[0].device_kind}")
+
+    if on_tpu:
+        # Llama-3.2-1B-shaped (tied embeddings, GQA 32/8, 16 layers).
+        cfg = ModelConfig(
+            vocab_size=128256,
+            hidden_size=2048,
+            n_layers=16,
+            n_heads=32,
+            n_kv_heads=8,
+            head_dim=64,
+            mlp_hidden=8192,
+            rope_theta=500000.0,
+            tie_embeddings=True,
+            # Pallas flash prefill: the XLA einsum path materializes
+            # [B, KVH, G, S, S] f32 scores (8.6 GB at batch 256) and runs out
+            # of memory at the largest batch.
+            attn_impl="flash",
+        )
+        batches, max_new, iters = [32, 64, 128, 256], 100, 3
+        dtype = jax.numpy.bfloat16
+    else:  # CPU smoke fallback so the bench still parses off-TPU
+        cfg = tiny_config(n_layers=4)
+        batches, max_new, iters = [8], 32, 2
+        dtype = jax.numpy.float32
+
+    tok = ByteTokenizer()
+    t0 = time.perf_counter()
+    # One compiled program for the whole init — eager per-tensor init pays a
+    # host<->device dispatch round-trip per parameter, which dominated r03's
+    # bench startup (50s for 1.24B params).
+    init = jax.jit(init_params, static_argnames=("cfg", "dtype"))
+    params = init(cfg, jax.random.key(0), dtype=dtype)
+    jax.block_until_ready(params)
+    log(f"init {sum(x.size for x in jax.tree.leaves(params))/1e9:.2f}B params "
+        f"in {time.perf_counter()-t0:.1f}s")
+
+    runner = ModelRunner(params, cfg, tok, model_name="bench-llama1b-shape")
+
+    # Honest output check: token-id statistics from one token-level run
+    # (decoded text can't prove anything — the byte tokenizer drops ids>=256).
+    stats_batch = min(batches[0], 32)
+    prompts, vecs, starts = _build_workload(cfg, tok, stats_batch)
+    stats = _token_stats(runner, cfg, prompts, vecs, starts, max_new)
+    log(f"token stats: {stats}")
+    # A random-init model under strength-4 steering legitimately emits
+    # near-constant ids per row (the injected vector dominates the residual
+    # stream and the logits are extremely peaked), so the honest checks are:
+    # rows actually generate (non-pad) and per-row steering differentiates
+    # the batch — not text quality.
+    if on_tpu and (
+        stats["nonpad_frac"] < 0.5
+        or stats["distinct_rows_by_first_token"] < stats_batch // 4
+    ):
+        log("FATAL: generation produced degenerate output "
+            "(mostly pad, or per-prompt steering is not differentiating rows)")
+        raise SystemExit(1)
+
+    # ---- batch sweep, bf16 -------------------------------------------------
+    results = [
+        _timed_config(runner, cfg, tok, b, max_new, iters, "bf16")
+        for b in batches
+    ]
+
+    # ---- int8 weight-quantized variant at the best bf16 batch --------------
+    if on_tpu:
+        best_bf16 = max(results, key=lambda r: r["evals_per_sec_chip"])
+        q_runner = ModelRunner(
+            quantize_params(params, bits=8, dtype=dtype), cfg, tok,
+            model_name="bench-llama1b-int8",
+        )
+        results.append(
+            _timed_config(
+                q_runner, cfg, tok, best_bf16["batch"], max_new, iters, "int8"
+            )
+        )
+
+    best = max(results, key=lambda r: r["evals_per_sec_chip"])
+    prompt_len = stats["prompt_len"]
+    peak = _peak_hbm_gbps()
+    hbm_util = None
+    if peak and on_tpu:
+        best_runner = q_runner if best["label"] == "int8" else runner
+        bytes_per_step = _hbm_model(
+            best_runner, cfg, best["batch"], prompt_len, max_new
+        )
+        eff_gbps = bytes_per_step * best["decode_steps_per_sec"] / 1e9
+        hbm_util = eff_gbps / peak
+        log(
+            f"modeled HBM traffic at best config: {bytes_per_step/1e9:.2f} GB/step "
+            f"x {best['decode_steps_per_sec']:.0f} steps/s = {eff_gbps:.0f} GB/s "
+            f"({100 * hbm_util:.0f}% of {peak:.0f} GB/s peak)"
+        )
 
     print(json.dumps({
         "metric": "injected-thought evals/sec/chip",
-        "value": round(evals_per_sec_chip, 4),
-        "unit": f"evals/s/chip (batch={batch}, {max_new} new tokens, "
-                f"1B-shape, {backend})",
+        "value": round(best["evals_per_sec_chip"], 4),
+        "unit": f"evals/s/chip (batch={best['batch']}, {best['label']}, "
+                f"{max_new} new tokens, 1B-shape, {backend})",
         "vs_baseline": None,
+        "hbm_utilization": None if hbm_util is None else round(hbm_util, 3),
+        "gen_tok_per_sec": round(best["gen_tok_per_sec"], 1),
+        "batch_sweep": [
+            {k: (round(v, 3) if isinstance(v, float) else v) for k, v in r.items()}
+            for r in results
+        ],
+        "token_stats": stats,
     }))
 
 

@@ -29,18 +29,19 @@ _NEG_INF = -1e30
 def _flash_kernel(
     window_ref, qpos_ref, kpos_ref, kvalid_ref, q_ref, k_ref, v_ref, o_ref,
     m_scr, l_scr, acc_scr,
-    *, scale: float, softcap: float | None,
+    *, scale: float, softcap: float | None, groups: int,
 ):
-    """One (batch, head, q-block, kv-block) grid step.
+    """One (batch, q-block, kv-block) grid step covering ALL heads.
 
-    KV chunks are the innermost grid dimension — each step sees ONE
-    [block_kv, D] K/V tile in VMEM (peak VMEM is O(block_q·block_kv +
-    block_q·D) regardless of sequence length). The online-softmax state
-    (m, l, acc) lives in VMEM scratch, which persists across the
-    sequentially-executed grid steps of the same q-block.
+    Every query head of the batch row shares the kv tile fetched for this
+    step, so K/V stream from HBM exactly once per (batch, q-block) sweep —
+    a per-head grid would re-fetch each kv tile ``groups`` times for GQA and
+    once per query head overall (measured ~4x redundant KV traffic on the
+    1B bench shape). KV chunks are the innermost grid dimension; the online
+    softmax state (m, l, acc) lives in VMEM scratch per head, persisting
+    across the sequentially-executed kv steps of the same q block.
     """
-    t = pl.program_id(3)
-    q = q_ref[0, 0, :, :].astype(jnp.float32)  # [BQ, D]
+    t = pl.program_id(2)
     qp = qpos_ref[0, 0, :]  # [BQ] int32
     # Traced sliding window (<=0 disables): a runtime operand so Gemma's
     # alternating local/global layers share one compiled kernel.
@@ -71,36 +72,60 @@ def _flash_kernel(
 
     @pl.when(tile_live)
     def _update():
-        k = k_ref[0, 0, :, :].astype(jnp.float32)  # [BK, D]
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [BQ, BK]
-        if softcap is not None:
-            s = softcap * jnp.tanh(s / softcap)
+        # Shared position-space mask — identical for every head. The G query
+        # heads of one KV head are merged into the dot's row dim (g-major),
+        # so the mask tiles G times over rows.
         allowed = (kp[None, :] <= qp[:, None]) & has_valid[None, :]
         allowed &= (window <= 0) | ((qp[:, None] - kp[None, :]) < window)
-        s = jnp.where(allowed, s, _NEG_INF)
+        allowed_g = jnp.tile(allowed, (groups, 1))  # [G*BQ, BK]
+        allowed_f = allowed_g.astype(jnp.float32)
 
-        m = m_scr[:]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        # Multiply by `allowed`, don't rely on exp underflow: on a fully-
-        # masked row m_new is still _NEG_INF, so exp(s - m_new) = exp(0) = 1
-        # for every masked entry — the explicit mask keeps l at 0 there
-        # (row → zeros).
-        p = jnp.exp(s - m_new) * allowed.astype(jnp.float32)
-        alpha = jnp.exp(m - m_new)
-        m_scr[:] = m_new
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        kvh = k_ref.shape[1]
+        G, BQ, D = q_ref.shape[2], q_ref.shape[3], q_ref.shape[4]
 
-    @pl.when(t == pl.num_programs(3) - 1)
+        def per_kv_head(i, _):
+            # A real loop (not a static unroll): Mosaic allocates kernel
+            # stack for every unrolled iteration's temporaries at once, and
+            # 32 heads of [BQ, BK] f32 scores blow the scoped-vmem limit.
+            q = q_ref[0, pl.dslice(i, 1)].reshape(G * BQ, D).astype(jnp.float32)
+            k = k_ref[0, pl.dslice(i, 1)].reshape(-1, D).astype(jnp.float32)
+            v = v_ref[0, pl.dslice(i, 1)].reshape(-1, D).astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [G*BQ, BK]
+            if softcap is not None:
+                s = softcap * jnp.tanh(s / softcap)
+            s = jnp.where(allowed_g, s, _NEG_INF)
+
+            ix = pl.dslice(i, 1)
+            m = m_scr[ix][0]
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            # Multiply by `allowed`, don't rely on exp underflow: on a fully-
+            # masked row m_new is still _NEG_INF, so exp(s - m_new) = 1 for
+            # every masked entry — the explicit mask keeps l at 0 there
+            # (row → zeros).
+            p = jnp.exp(s - m_new) * allowed_f
+            alpha = jnp.exp(m - m_new)
+            m_scr[ix] = m_new[None]
+            l_scr[ix] = l_scr[ix] * alpha[None] + jnp.sum(
+                p, axis=-1, keepdims=True
+            )[None]
+            acc_scr[ix] = acc_scr[ix] * alpha[None] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )[None]
+            return 0
+
+        jax.lax.fori_loop(0, kvh, per_kv_head, 0)
+
+    @pl.when(t == pl.num_programs(2) - 1)
     def _finish():
         # Fully-masked rows (pad queries) have l == 0; emit zeros, not NaN.
+        KVH, GBQ, D = acc_scr.shape
         o = acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0, 0, :, :] = o.astype(o_ref.dtype)
+        G = o_ref.shape[2]
+        o_ref[0, :, :, :, :] = o.reshape(KVH, G, GBQ // G, D).astype(o_ref.dtype)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -123,7 +148,7 @@ def flash_attention(
     softcap: float | None = None,
     window=None,  # int / traced int32 scalar; None or <=0 disables
     block_q: int = 128,
-    block_kv: int = 128,
+    block_kv: int = 256,
     interpret: bool = False,
 ) -> jax.Array:
     """Fused attention, causal in position space. Returns [B, S, NH, D].
@@ -137,6 +162,8 @@ def flash_attention(
     T, KVH = k.shape[1], k.shape[2]
     groups = NH // KVH
 
+    block_q = min(block_q, _round_up(S, 8))
+    block_kv = min(block_kv, _round_up(T, 128))
     s_pad = _round_up(S, block_q)
     t_pad = _round_up(T, block_kv)
     if s_pad != S:
@@ -148,48 +175,52 @@ def flash_attention(
         kv_positions = jnp.pad(kv_positions, ((0, 0), (0, t_pad - T)))
         kv_valid = jnp.pad(kv_valid, ((0, 0), (0, t_pad - T)))
     # Mosaic needs the last two BLOCK dims divisible by (8, 128) or equal to
-    # the full array dims, so Q/K/V go through the kernel as [B, H, S, D]
-    # (block (1, 1, block, D)) and the per-batch 1-D operands as [B, 1, S].
+    # the full array dims, so Q goes through the kernel as [B, KVH, G, S, D]
+    # (query heads grouped under their KV head — HF convention h // groups),
+    # K/V as [B, KVH, T, D], and the per-batch 1-D operands as [B, 1, S].
     kv_valid = kv_valid.astype(jnp.int32)[:, None, :]
     q_positions = q_positions.astype(jnp.int32)[:, None, :]
     kv_positions = kv_positions.astype(jnp.int32)[:, None, :]
-    q = q.transpose(0, 2, 1, 3)  # [B, NH, S, D]
+    q = q.transpose(0, 2, 1, 3).reshape(B, KVH, groups, s_pad, D)
     k = k.transpose(0, 2, 1, 3)  # [B, KVH, T, D]
     v = v.transpose(0, 2, 1, 3)
     if window is None:
         window = 0  # disabled
     window_arr = jnp.asarray(window, jnp.int32).reshape(1)
 
-    grid = (B, NH, s_pad // block_q, t_pad // block_kv)
+    grid = (B, s_pad // block_q, t_pad // block_kv)
 
     out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, softcap=softcap),
+        functools.partial(
+            _flash_kernel, scale=scale, softcap=softcap, groups=groups
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # window (scalar)
-            pl.BlockSpec((1, 1, block_q), lambda b, h, s, t: (b, 0, s)),  # q_positions
-            pl.BlockSpec((1, 1, block_kv), lambda b, h, s, t: (b, 0, t)),  # kv_positions
-            pl.BlockSpec((1, 1, block_kv), lambda b, h, s, t: (b, 0, t)),  # kv_valid
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, s, t: (b, h, s, 0)),  # q
+            pl.BlockSpec((1, 1, block_q), lambda b, s, t: (b, 0, s)),  # q_positions
+            pl.BlockSpec((1, 1, block_kv), lambda b, s, t: (b, 0, t)),  # kv_positions
+            pl.BlockSpec((1, 1, block_kv), lambda b, s, t: (b, 0, t)),  # kv_valid
             pl.BlockSpec(
-                (1, 1, block_kv, D), lambda b, h, s, t: (b, h // groups, t, 0)
-            ),  # k
-            pl.BlockSpec(
-                (1, 1, block_kv, D), lambda b, h, s, t: (b, h // groups, t, 0)
-            ),  # v
+                (1, KVH, groups, block_q, D), lambda b, s, t: (b, 0, 0, s, 0)
+            ),  # q
+            pl.BlockSpec((1, KVH, block_kv, D), lambda b, s, t: (b, 0, t, 0)),  # k
+            pl.BlockSpec((1, KVH, block_kv, D), lambda b, s, t: (b, 0, t, 0)),  # v
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, s, t: (b, h, s, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, NH, s_pad, D), q.dtype),
+        out_specs=pl.BlockSpec(
+            (1, KVH, groups, block_q, D), lambda b, s, t: (b, 0, 0, s, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, KVH, groups, s_pad, D), q.dtype),
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
-            pltpu.VMEM((block_q, 1), jnp.float32),  # running sum
-            pltpu.VMEM((block_q, D), jnp.float32),  # accumulator
+            pltpu.VMEM((KVH, groups * block_q, 1), jnp.float32),  # running max
+            pltpu.VMEM((KVH, groups * block_q, 1), jnp.float32),  # running sum
+            pltpu.VMEM((KVH, groups * block_q, D), jnp.float32),  # accumulator
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(window_arr, q_positions, kv_positions, kv_valid, q, k, v)
+    out = out.reshape(B, NH, s_pad, D)
     return out.transpose(0, 2, 1, 3)[:, :S]
 
 

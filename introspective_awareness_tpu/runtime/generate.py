@@ -30,7 +30,15 @@ from introspective_awareness_tpu.models.transformer import (
     forward,
     init_cache,
     make_positions,
+    merge_ring,
 )
+
+# Decode steps between ring merges (the ring capacity). Per-step KV appends
+# touch only a [L, RING_CHUNK, B, heads*dim] scratch — XLA's slot-minor
+# layout choice makes single-slot writes into a big [.., T, ..] buffer a
+# read-modify-write of the whole per-layer slab, so the big buffer takes one
+# chunked append every RING_CHUNK steps instead (see KVCache / merge_ring).
+RING_CHUNK = 16
 
 
 class GenSpec(NamedTuple):
@@ -75,7 +83,18 @@ def generate_tokens(
         jnp.ones((B, 1), jnp.float32),
     )
 
-    cache = init_cache(cfg, B, S + max_new_tokens, dtype)
+    steps_total = max_new_tokens - 1
+    n_chunks = -(-steps_total // RING_CHUNK) if steps_total else 0
+    # Even the chunks out (99 steps -> 7x15, not 7x16): every chunk runs in
+    # full, so the final chunk's overrun past steps_total is wasted forward
+    # passes. EOS early-exit is likewise chunk-granular — up to ch-1 steps
+    # run after the last row finishes, the price of keeping per-step ring
+    # appends off the big slot buffer.
+    ch = -(-steps_total // n_chunks) if n_chunks else 1
+    # The main slot buffer holds the prompt plus every merged chunk; the last
+    # chunk may overrun past max_new (those slots are written but the outer
+    # loop ends before anything could read them).
+    cache = init_cache(cfg, B, S + n_chunks * ch, dtype, ring_len=ch)
     r = forward(
         params, cfg, ids, mask, positions,
         cache=cache, steer=steer_prompt, use_cache=True, logits_mode="last",
@@ -83,29 +102,28 @@ def generate_tokens(
     )
 
     def sample(logits, key):
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        temp = jnp.maximum(spec.temperature, 1e-6)
-        sampled = jax.random.categorical(key, logits / temp, axis=-1).astype(jnp.int32)
-        return jnp.where(spec.temperature > 0, sampled, greedy)
+        # categorical(logits / T) == argmax(logits + T * gumbel) for T > 0,
+        # and T <= 0 (clamped) reduces exactly to greedy argmax — one
+        # formula, one full-vocab reduce per step instead of separate greedy
+        # + categorical passes (each is a [B, V] reduction; V is 128k on
+        # Llama-3).
+        g = jax.random.gumbel(key, logits.shape, logits.dtype)
+        temp = jnp.maximum(spec.temperature, 0.0)
+        return jnp.argmax(logits + temp * g, axis=-1).astype(jnp.int32)
 
     key, sub = jax.random.split(spec.rng)
     tok0 = sample(r.logits, sub)
     done0 = jnp.isin(tok0, spec.eos_ids)
 
-    # Early-exit decode: a while_loop stops as soon as every row has hit EOS
-    # (the reference's model.generate stops the same way). At temp 1.0 most
-    # introspection responses end well before max_tokens, so this trims the
-    # tail of dead decode steps; the padded-token output is identical to a
-    # full-length scan.
-    tokens0 = jnp.full((B, max_new_tokens), spec.pad_id, jnp.int32)
+    # Early-exit decode: the outer (per-chunk) while_loop stops as soon as
+    # every row has hit EOS (the reference's model.generate stops the same
+    # way), at chunk granularity. The token buffer is padded to whole chunks;
+    # overrun steps write into the padded tail, sliced off on return.
+    tokens0 = jnp.full((B, n_chunks * ch + 1), spec.pad_id, jnp.int32)
     tokens0 = tokens0.at[:, 0].set(tok0)
 
-    def cond(carry):
-        t, _cache, _prev, done, _key, _tokens = carry
-        return (t < max_new_tokens) & ~jnp.all(done)
-
-    def body(carry):
-        t, cache, prev, done, key, tokens = carry
+    def step(carry, t):
+        cache, prev, done, key, tokens = carry
         key, sub = jax.random.split(key)
         step_pos = (true_len + t - 1)[:, None]
         out = forward(
@@ -116,11 +134,27 @@ def generate_tokens(
         nxt = jnp.where(done, spec.pad_id, nxt)
         done = done | jnp.isin(nxt, spec.eos_ids)
         tokens = lax.dynamic_update_slice(tokens, nxt[:, None], (0, t))
-        return t + 1, out.cache, nxt, done, key, tokens
+        return out.cache, nxt, done, key, tokens
 
-    if max_new_tokens > 1:
-        carry = (jnp.int32(1), r.cache, tok0, done0, key, tokens0)
-        _, _, _, _, _, tokens = lax.while_loop(cond, body, carry)
+    def chunk_cond(carry):
+        cc, _cache, _prev, done, _key, _tokens = carry
+        return (cc < n_chunks) & ~jnp.all(done)
+
+    def chunk_body(carry):
+        cc, cache, prev, done, key, tokens = carry
+
+        def inner(i, c):
+            cache, prev, done, key, tokens = c
+            return step((cache, prev, done, key, tokens), cc * ch + i + 1)
+
+        cache, prev, done, key, tokens = lax.fori_loop(
+            0, ch, inner, (cache, prev, done, key, tokens)
+        )
+        return cc + 1, merge_ring(cache, cfg), prev, done, key, tokens
+
+    if steps_total > 0:
+        carry = (jnp.int32(0), r.cache, tok0, done0, key, tokens0)
+        _, _, _, _, _, tokens = lax.while_loop(chunk_cond, chunk_body, carry)
     else:
         tokens = tokens0
-    return tokens
+    return tokens[:, :max_new_tokens]

@@ -91,6 +91,19 @@ def test_sampling_determinism(runner):
     assert a != c  # overwhelmingly likely for 8 byte-tokens x 3 prompts
 
 
+def test_generate_chunk_size_invariance(runner, monkeypatch):
+    """Greedy generation is identical whether the decode ring merges every 3
+    steps or never (single chunk) — chunking is an execution detail, not a
+    semantic one."""
+    from introspective_awareness_tpu.runtime import generate as gen
+
+    monkeypatch.setattr(gen, "RING_CHUNK", 3)
+    a = runner.generate_batch(PROMPTS, max_new_tokens=20, temperature=0.0)
+    monkeypatch.setattr(gen, "RING_CHUNK", 64)
+    b = runner.generate_batch(PROMPTS, max_new_tokens=20, temperature=0.0)
+    assert a == b
+
+
 def test_extract_activations_ragged_batch(runner):
     """Activations for a prompt are identical whether extracted alone or in a
     ragged batch (left-pad correctness of the capture index)."""

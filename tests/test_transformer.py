@@ -15,6 +15,7 @@ from introspective_awareness_tpu.models import (
     make_positions,
     tiny_config,
 )
+from introspective_awareness_tpu.models.transformer import merge_ring
 
 
 @pytest.fixture(scope="module")
@@ -119,8 +120,11 @@ def test_prefill_decode_matches_full_forward(cfg, params):
     pos = make_positions(mask)
     true_len = mask.sum(axis=1)
 
-    cache = init_cache(cfg, B, S + steps)
-    out = forward(params, cfg, ids, mask, pos, cache=cache, use_cache=True)
+    cache = init_cache(cfg, B, S, ring_len=steps)
+    out = forward(
+        params, cfg, ids, mask, pos, cache=cache, use_cache=True,
+        is_prefill=True,
+    )
     cache = out.cache
     seq = np.asarray(ids)
     logits = out.logits
@@ -134,6 +138,50 @@ def test_prefill_decode_matches_full_forward(cfg, params):
             params, cfg, jnp.asarray(seq), fmask, make_positions(fmask)
         ).logits
         # Incremental step:
+        step_pos = (true_len + t)[:, None]
+        out = forward(
+            params, cfg, nxt[:, None], jnp.ones((B, 1), jnp.int32), step_pos,
+            cache=cache, use_cache=True,
+        )
+        cache = out.cache
+        logits = out.logits
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_decode_ring_merge_matches_full_forward(cfg, params):
+    """Multi-chunk decode: the ring fills up and merges into the main slot
+    buffer every ``ring`` steps (runtime.generate's chunked loop calls
+    merge_ring the same way); logits must keep matching the full forward
+    across merge boundaries — this is the path real 100+-token generations
+    take after the first RING_CHUNK steps."""
+    B, S, ring, steps = 2, 7, 3, 7
+    key = jax.random.key(9)
+    ids = _ids(key, B, S, cfg.vocab_size)
+    mask = jnp.ones((B, S), jnp.int32)
+    pos = make_positions(mask)
+    true_len = mask.sum(axis=1)
+
+    n_merges = -(-steps // ring)
+    cache = init_cache(cfg, B, S + n_merges * ring, ring_len=ring)
+    out = forward(
+        params, cfg, ids, mask, pos, cache=cache, use_cache=True,
+        is_prefill=True,
+    )
+    cache = out.cache
+    seq = np.asarray(ids)
+    logits = out.logits
+
+    for t in range(steps):
+        if int(cache.rlen) == ring:
+            cache = merge_ring(cache, cfg)
+        nxt = jnp.argmax(logits, axis=-1)
+        seq = np.concatenate([seq, np.asarray(nxt)[:, None]], axis=1)
+        fmask = jnp.ones((B, seq.shape[1]), jnp.int32)
+        ref_logits = forward(
+            params, cfg, jnp.asarray(seq), fmask, make_positions(fmask)
+        ).logits
         step_pos = (true_len + t)[:, None]
         out = forward(
             params, cfg, nxt[:, None], jnp.ones((B, 1), jnp.int32), step_pos,
