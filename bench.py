@@ -273,7 +273,68 @@ def main() -> None:
             )
         )
 
-    best = max(results, key=lambda r: r["evals_per_sec_chip"])
+    # ---- on-device judge interleaving cost ---------------------------------
+    # The BASELINE "no API in the loop" config co-locates a grader model on
+    # the same chip. Measure the full loop: subject generates a batch, then
+    # the grader runs stage-1 claims grading over every response (stage 2
+    # only triggers for claimers, so this is the steady-state floor).
+    if on_tpu:
+        from introspective_awareness_tpu.judge import LLMJudge, OnDeviceJudgeClient
+        from introspective_awareness_tpu.judge.judge import reconstruct_trial_prompts
+
+        # A second, independently-initialized parameter set: co-residency
+        # means BOTH models' weights live in HBM at once.
+        grader_params = init(cfg, jax.random.key(1), dtype=dtype)
+        grader = ModelRunner(
+            grader_params, cfg, tok, model_name="bench-grader-1b-shape"
+        )
+        judge = LLMJudge(
+            client=OnDeviceJudgeClient(grader, max_tokens=32, chunk_size=64)
+        )
+        b = min(64, best_bf16["batch"])
+        prompts, vecs, starts = _build_workload(cfg, tok, b)
+
+        def run_with_grading(seed):
+            responses = runner.generate_batch_with_multi_steering(
+                prompts, layer_idx=int(cfg.n_layers * 0.6),
+                steering_vectors=list(vecs), strength=4.0,
+                max_new_tokens=max_new, temperature=1.0,
+                steering_start_positions=starts, seed=seed,
+            )
+            rs = [
+                {"concept": "bench", "response": r, "trial": i + 1,
+                 "trial_type": "injection"}
+                for i, r in enumerate(responses)
+            ]
+            return judge.evaluate_batch(rs, reconstruct_trial_prompts(rs))
+
+        t0 = time.perf_counter()
+        run_with_grading(0)
+        warm = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(2):
+            run_with_grading(i + 1)
+        dt = time.perf_counter() - t0
+        judged_rate = 2 * b / dt / jax.device_count()
+        log(
+            f"  [bf16+on-device judge] batch={b}: "
+            f"{judged_rate:.1f} graded evals/s/chip (warmup {warm:.1f}s) — "
+            "generation + stage-1 grading by a co-resident same-size grader"
+        )
+        results.append({
+            "label": "bf16+judge", "batch": b,
+            "evals_per_sec_chip": judged_rate,
+            "gen_tok_per_sec": 0.0,
+            "decode_steps_per_sec": 0.0,
+            "warmup_s": round(warm, 2), "timed_s": round(dt, 2),
+        })
+
+    # Judge-graded throughput is a different workload; the headline metric
+    # stays pure generation.
+    best = max(
+        (r for r in results if r["label"] != "bf16+judge"),
+        key=lambda r: r["evals_per_sec_chip"],
+    )
     prompt_len = stats["prompt_len"]
     peak = _peak_hbm_gbps()
     hbm_util = None

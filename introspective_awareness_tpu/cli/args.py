@@ -50,6 +50,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-t", "--temperature", type=float, default=DEFAULT_TEMPERATURE)
     parser.add_argument("-mt", "--max-tokens", type=int, default=DEFAULT_MAX_TOKENS)
     parser.add_argument("-bs", "--batch-size", type=int, default=DEFAULT_BATCH_SIZE)
+    parser.add_argument("--fuse-cells", type=str, default="auto",
+                        choices=["auto", "on", "off"],
+                        help="Pack multiple (layer, strength) cells into one "
+                             "generation batch when a single cell underfills "
+                             "--batch-size (auto). Per-example layer/strength "
+                             "operands keep it one compiled executable; "
+                             "per-cell artifacts are unchanged.")
     parser.add_argument("-od", "--output-dir", type=str, default=DEFAULT_OUTPUT_DIR)
     parser.add_argument("-dt", "--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float16", "float32"])

@@ -77,6 +77,32 @@ def create_sweep_plots(
         fig.savefig(individual / f"heatmap_{concept}.png", dpi=100)
         plt.close(fig)
 
+    # Per-concept detection-rate line plots with binomial-SE bars: one figure
+    # vs strength (line per layer) and one vs layer (line per strength)
+    # (reference detect_injected_thoughts.py:626-670).
+    for concept in concepts:
+        for xlabel, xs, lines, line_label, key_of, fname in (
+            ("Steering Strength", strengths, layer_fractions, "Layer {v:.2f}",
+             lambda lf, s: (lf, s), f"{concept}_strength_sweep.png"),
+            ("Layer Fraction", layer_fractions, strengths, "Strength {v:g}",
+             lambda s, lf: (lf, s), f"{concept}_layer_sweep.png"),
+        ):
+            fig, ax = plt.subplots(figsize=(10, 7))
+            for v in lines:
+                pts = [rates[concept].get(key_of(v, x), (0.0, 0.0)) for x in xs]
+                ax.errorbar(
+                    xs, [p[0] for p in pts], yerr=[p[1] for p in pts],
+                    marker="o", capsize=5, label=line_label.format(v=v),
+                )
+            ax.set_xlabel(xlabel)
+            ax.set_ylabel("Detection Rate")
+            ax.set_title(f"{concept}: Detection Rate vs {xlabel.split()[-1]}")
+            ax.set_ylim(-0.05, 1.05)
+            ax.legend()
+            fig.tight_layout()
+            fig.savefig(individual / fname, dpi=100)
+            plt.close(fig)
+
     # Mean-over-concepts judge-metric line plots with binomial SE bars
     def metric_grid(key: str) -> np.ndarray:
         grid = np.full((len(layer_fractions), len(strengths)), np.nan)

@@ -35,7 +35,7 @@ from introspective_awareness_tpu.metrics import (
 )
 from introspective_awareness_tpu.judge.judge import reconstruct_trial_prompts
 from introspective_awareness_tpu.models.registry import get_layer_at_fraction
-from introspective_awareness_tpu.protocol.trials import run_trial_pass
+from introspective_awareness_tpu.protocol.trials import run_grid_pass, run_trial_pass
 from introspective_awareness_tpu.vectors import (
     extract_concept_vectors_all_layers,
     get_baseline_words,
@@ -70,6 +70,16 @@ def _keyword_metrics(results: list[dict]) -> dict:
             sum(r["detected"] for r in forced) / len(forced) if forced else 0
         ),
     }
+
+
+def _print_cell(lf: float, strength: float, metrics: dict) -> None:
+    comb = metrics.get("combined_detection_and_identification_rate")
+    print(
+        f"  L={lf:.2f} S={strength}: "
+        f"hit={metrics.get('detection_hit_rate', 0):.2f} "
+        f"fa={metrics.get('detection_false_alarm_rate', 0):.2f} "
+        f"comb={'--' if comb is None else f'{comb:.2f}'}"
+    )
 
 
 def _build_judge(args, mesh, rules):
@@ -203,6 +213,7 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
     n_generated = 0
     cell_times: list[float] = []
     cell_counts: list[int] = []
+    pending: list[tuple[int, float, int, float]] = []
     for ci, lf in enumerate(layer_fractions):
         layer_idx = get_layer_at_fraction(runner.n_layers, lf)
         for si, strength in enumerate(strengths):
@@ -216,26 +227,77 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 if args.reevaluate_judge and judge is not None:
                     # _cell_metrics runs the (single) judge pass itself.
                     metrics = _cell_metrics(results, judge, args, lf, layer_idx, strength)
-                    _save_cell(results, metrics, cell_dir)
+                    _save_cell(results, metrics, cell_dir, model_name)
                     print(f"  re-judged L={lf:.2f} S={strength}")
                 else:
                     metrics = saved.get("metrics", {})
                     print(f"  skip L={lf:.2f} S={strength} (results.json exists)")
                 all_results[(lf, strength)] = {"results": results, **metrics}
                 continue
+            pending.append((ci, lf, si, strength))
 
-            # ---- generate: 3 passes on one executable ---------------------
+    # Forced trials numbered after the spontaneous block
+    # (reference :1986 actual_trial_num = n_injection + n_control + t).
+    trial_plan = [
+        ("injection", range(1, n_injection + 1)),
+        ("control", range(1, n_control + 1)),
+        ("forced_injection", range(args.n_trials + 1, args.n_trials + n_injection + 1)),
+    ]
+    cell_task_max = len(args.concepts) * max(n_injection, n_control)
+    fuse = args.fuse_cells == "on" or (
+        args.fuse_cells == "auto"
+        and len(pending) > 1
+        and cell_task_max < args.batch_size
+    )
+
+    if pending and fuse:
+        # ---- fused: rows of ALL pending cells pack into shared batches ----
+        # Layer index and strength are per-example runtime operands, so the
+        # whole grid runs on the one compiled executable in full batches
+        # instead of one underfilled generate call per cell. Per-cell
+        # artifacts and metrics are identical to the per-cell path (exactly
+        # so at temperature 0; at temperature > 0 the same distribution with
+        # a different noise realization).
+        t0 = time.perf_counter()
+
+        def vector_lookup(lf, concept):
+            return vectors_by_fraction[lf][concept]
+
+        fused: list[dict] = []
+        for k, (trial_type, trial_nums) in enumerate(trial_plan):
+            tasks = [
+                (c, t, lf, get_layer_at_fraction(runner.n_layers, lf), strength)
+                for ci, lf, si, strength in pending
+                for c in args.concepts
+                for t in trial_nums
+            ]
+            fused += run_grid_pass(
+                runner, trial_type, tasks, vector_lookup,
+                max_new_tokens=args.max_tokens, temperature=args.temperature,
+                batch_size=args.batch_size, seed=args.seed + k * 1_000_003,
+            )
+        t_gen = time.perf_counter() - t0
+        n_generated = len(fused)
+        timings["fused_cells"] = len(pending)
+
+        by_cell: dict = {}
+        for r in fused:
+            by_cell.setdefault((r["layer_fraction"], r["strength"]), []).append(r)
+        for ci, lf, si, strength in pending:
+            results = by_cell.get((lf, strength), [])
+            layer_idx = get_layer_at_fraction(runner.n_layers, lf)
+            cell_dir = config_dir(args.output_dir, model_name, lf, strength)
+            metrics = _cell_metrics(results, judge, args, lf, layer_idx, strength)
+            _save_cell(results, metrics, cell_dir, model_name)
+            all_results[(lf, strength)] = {"results": results, **metrics}
+            _print_cell(lf, strength, metrics)
+    else:
+        for ci, lf, si, strength in pending:
+            # ---- per-cell: 3 passes on one executable ---------------------
+            layer_idx = get_layer_at_fraction(runner.n_layers, lf)
+            cell_dir = config_dir(args.output_dir, model_name, lf, strength)
             t0 = time.perf_counter()
             vectors = vectors_by_fraction[lf]
-            tasks_inj = [(c, t) for c in args.concepts for t in range(1, n_injection + 1)]
-            tasks_ctl = [(c, t) for c in args.concepts for t in range(1, n_control + 1)]
-            # Forced trials numbered after the spontaneous block
-            # (reference :1986 actual_trial_num = n_injection + n_control + t).
-            tasks_fcd = [
-                (c, args.n_trials + t)
-                for c in args.concepts
-                for t in range(1, n_injection + 1)
-            ]
             common = dict(
                 vectors=vectors, layer_idx=layer_idx, strength=strength,
                 max_new_tokens=args.max_tokens, temperature=args.temperature,
@@ -245,9 +307,10 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 layer_fraction=lf, batch_size=args.batch_size,
                 seed=args.seed + ci * len(strengths) + si,
             )
-            results = run_trial_pass(runner, "injection", tasks_inj, **common)
-            results += run_trial_pass(runner, "control", tasks_ctl, **common)
-            results += run_trial_pass(runner, "forced_injection", tasks_fcd, **common)
+            results = []
+            for trial_type, trial_nums in trial_plan:
+                tasks = [(c, t) for c in args.concepts for t in trial_nums]
+                results += run_trial_pass(runner, trial_type, tasks, **common)
             t_cell = time.perf_counter() - t0
             t_gen += t_cell
             n_generated += len(results)
@@ -255,15 +318,9 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             cell_counts.append(len(results))
 
             metrics = _cell_metrics(results, judge, args, lf, layer_idx, strength)
-            _save_cell(results, metrics, cell_dir)
+            _save_cell(results, metrics, cell_dir, model_name)
             all_results[(lf, strength)] = {"results": results, **metrics}
-            comb = metrics.get("combined_detection_and_identification_rate")
-            print(
-                f"  L={lf:.2f} S={strength}: "
-                f"hit={metrics.get('detection_hit_rate', 0):.2f} "
-                f"fa={metrics.get('detection_false_alarm_rate', 0):.2f} "
-                f"comb={'--' if comb is None else f'{comb:.2f}'}"
-            )
+            _print_cell(lf, strength, metrics)
 
     timings["generation_s"] = round(t_gen, 3)
     if n_generated and t_gen > 0:
@@ -325,9 +382,59 @@ def _cell_metrics(results, judge, args, lf, layer_idx, strength) -> dict:
     return metrics
 
 
-def _save_cell(results, metrics, cell_dir: Path) -> None:
+def _save_cell(results, metrics, cell_dir: Path, model_name: str = "") -> None:
     save_evaluation_results(results, cell_dir / "results.json", metrics)
     results_to_csv(results, cell_dir / "results.csv")
+    _write_cell_texts(results, metrics, cell_dir, model_name)
+
+
+def _write_cell_texts(results, metrics, cell_dir: Path, model_name: str) -> None:
+    """Per-config ``examples.txt`` (one sample response per concept) and
+    ``summary.txt`` (metrics dump) — the reference's single-config artifacts
+    (detect_injected_thoughts.py:510-549), written per sweep cell here."""
+    lf = metrics.get("layer_fraction")
+    header = [
+        "EXPERIMENT 1: INJECTED THOUGHTS DETECTION",
+        "=" * 80,
+        f"Model: {model_name}",
+        f"Layer: {metrics.get('layer_idx')} (fraction: {lf})",
+        f"Strength: {metrics.get('strength')}",
+        "",
+    ]
+    lines = list(header)
+    seen: set = set()
+    for r in results:
+        if r["concept"] in seen:
+            continue
+        seen.add(r["concept"])
+        lines += [
+            f"\nConcept: {r['concept']}",
+            "-" * 80,
+            f"Response: {r['response']}",
+            f"Detected: {r.get('detected', 'N/A')}",
+            "",
+        ]
+    (cell_dir / "examples.txt").write_text("\n".join(lines) + "\n")
+
+    concepts = {r["concept"] for r in results}
+    trials = {r["trial"] for r in results}
+    lines = [
+        "EXPERIMENT 1: SUMMARY",
+        "=" * 80,
+        f"Model: {model_name}",
+        f"Test concepts: {len(concepts)}",
+        f"Trials per concept: {len(trials)}",
+        f"Total samples: {len(results)}",
+        f"\nLayer: {metrics.get('layer_idx')} (fraction: {lf})",
+        f"Strength: {metrics.get('strength')}",
+        "\nMETRICS:",
+    ]
+    for key, value in metrics.items():
+        if isinstance(value, float):
+            lines.append(f"  {key}: {value:.4f}")
+        else:
+            lines.append(f"  {key}: {value}")
+    (cell_dir / "summary.txt").write_text("\n".join(lines) + "\n")
 
 
 def _write_manifest(out_base: Path, args, runner, timings: dict) -> None:
@@ -423,7 +530,7 @@ def _rejudge_cells(args, judge, model_name: str) -> dict:
             results = saved.get("results", [])
             layer_idx = saved.get("metrics", {}).get("layer_idx", -1)
             metrics = _cell_metrics(results, judge, args, lf, layer_idx, strength)
-            _save_cell(results, metrics, cell_dir)
+            _save_cell(results, metrics, cell_dir, model_name)
             print(f"  re-judged L={lf:.2f} S={strength}")
             all_results[(lf, strength)] = {"results": results, **metrics}
     out_base = Path(args.output_dir) / model_name.replace("/", "_")

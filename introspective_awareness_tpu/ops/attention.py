@@ -7,11 +7,15 @@ makes causal + left-padding + sliding-window all simple vector compares
 inside the kernel, identical to the semantics of the model's mask
 construction (models/transformer.py `forward`).
 
-Algorithm: grid over (batch, query head, query block, KV chunk) with the KV
-chunk innermost ("arbitrary" = sequential); the online-softmax state
-(running max, sum, accumulator) lives in VMEM scratch across KV steps, so
-peak VMEM is O(block_q x block_kv + block_q x head_dim) regardless of
-sequence length.
+Algorithm: grid over (batch, query block, KV chunk) with the KV chunk
+innermost ("arbitrary" = sequential) and ALL heads handled inside one grid
+step (a fori_loop over KV heads, each step computing its ``groups`` query
+heads in one dot) — so each KV tile streams from HBM once per q-block sweep
+instead of once per query head. The online-softmax state (running max, sum,
+accumulator) lives in VMEM scratch across KV steps; peak VMEM is
+O(groups x block_q x (block_kv + KVH x head_dim)) — the f32 scores for one
+KV-head group plus the per-head accumulators — regardless of sequence
+length, and must fit the TPU's ~16 MB scoped-vmem limit when sizing blocks.
 """
 
 from __future__ import annotations

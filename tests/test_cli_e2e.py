@@ -52,6 +52,11 @@ def test_artifact_layout(sweep_out):
         assert data["n_samples"] == 2 * (2 + 2 + 2)  # concepts x (inj+ctl+forced)
         assert "detection_hit_rate" in data["metrics"]
         assert (model_dir / cell / "results.csv").exists()
+        # Per-config text dumps (reference examples.txt / summary.txt)
+        examples = (model_dir / cell / "examples.txt").read_text()
+        assert "Concept: Dust" in examples and "Response:" in examples
+        summary = (model_dir / cell / "summary.txt").read_text()
+        assert "METRICS:" in summary and "detection_hit_rate" in summary
     # vectors saved per swept fraction
     assert (model_dir / "vectors" / "layer_0.25" / "Dust.npz").exists()
     assert (model_dir / "vectors" / "layer_0.75" / "Trees.json").exists()
@@ -79,6 +84,10 @@ def test_trial_mix_and_numbering(sweep_out):
 def test_plots_and_debug(sweep_out):
     plots = sweep_out / "tiny" / "plots"
     assert (plots / "individual" / "heatmap_Dust.png").exists()
+    # Per-concept line plots (reference {concept}_strength_sweep.png /
+    # {concept}_layer_sweep.png)
+    assert (plots / "individual" / "Dust_strength_sweep.png").exists()
+    assert (plots / "individual" / "Trees_layer_sweep.png").exists()
     assert (plots / "sweep_detection_hit_rate.png").exists()
     debug = sweep_out / "tiny" / "debug"
     for f in (
@@ -107,6 +116,48 @@ def test_resume_skips_existing(sweep_out, tmp_path, capsys):
     assert before == after
 
 
+def test_fused_grid_matches_per_cell(tmp_path, monkeypatch):
+    """--fuse-cells on packs all four cells' rows into shared batches: at
+    temperature 0 every per-cell results.json (responses AND metrics) is
+    byte-identical to the per-cell path, with strictly fewer generate calls
+    (the fused path's whole point)."""
+    import introspective_awareness_tpu.runtime.runner as runner_mod
+
+    calls = {"n": 0}
+    orig = runner_mod.ModelRunner._generate
+
+    def counting(self, *a, **k):
+        calls["n"] += 1
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(runner_mod.ModelRunner, "_generate", counting)
+
+    calls["n"] = 0
+    assert _run(tmp_path / "off", extra=["--fuse-cells", "off"]) == 0
+    n_off = calls["n"]
+    calls["n"] = 0
+    assert _run(tmp_path / "fused", extra=["--fuse-cells", "on"]) == 0
+    n_fused = calls["n"]
+    assert n_fused < n_off  # 4 cells x 3 passes -> 3 fused passes
+
+    for cell in (
+        "layer_0.25_strength_2.0", "layer_0.25_strength_8.0",
+        "layer_0.75_strength_2.0", "layer_0.75_strength_8.0",
+    ):
+        a = json.loads(
+            (tmp_path / "off" / "out" / "tiny" / cell / "results.json").read_text()
+        )
+        b = json.loads(
+            (tmp_path / "fused" / "out" / "tiny" / cell / "results.json").read_text()
+        )
+        assert a["results"] == b["results"]
+        assert a["metrics"] == b["metrics"]
+    man = json.loads(
+        (tmp_path / "fused" / "out" / "tiny" / "run_manifest.json").read_text()
+    )
+    assert man["timings"]["fused_cells"] == 4
+
+
 def test_single_cell_and_overwrite(tmp_path):
     argv_base = [
         "--models", "tiny:3",
@@ -126,6 +177,30 @@ def test_single_cell_and_overwrite(tmp_path):
     first = (cell / "results.json").stat().st_mtime
     assert main(argv_base + ["--overwrite"]) == 0
     assert (cell / "results.json").stat().st_mtime >= first
+
+
+def test_on_device_judge_coresidency(tmp_path):
+    """Subject AND grader ModelRunners co-resident on the one mesh, through
+    the real CLI path (--judge-backend on-device): the subject generates the
+    trials, the grader's sharded params share the chips, and the two-stage
+    grading flow attaches evaluations + judge-sourced metrics. This is the
+    BASELINE 'no API in the loop' configuration, shape-checked end to end
+    (the tiny random grader answers garbage, so stage 2 rarely triggers —
+    the scripted-client tests cover claimer routing)."""
+    assert _run(
+        tmp_path,
+        extra=["--judge-backend", "on-device", "--judge-model", "tiny:1",
+               "--layer-sweep", "0.5", "--strength-sweep", "4.0"],
+    ) == 0
+    data = json.loads(
+        (tmp_path / "out" / "tiny" / "layer_0.50_strength_4.0" / "results.json")
+        .read_text()
+    )
+    assert data["metrics"]["metrics_source"] == "judge"
+    assert all("evaluations" in r for r in data["results"])
+    assert all(
+        "claims_detection" in r["evaluations"] for r in data["results"]
+    )
 
 
 def test_models_all_rescan(sweep_out, capsys):

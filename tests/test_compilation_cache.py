@@ -31,6 +31,9 @@ def _run_sweep(out_dir: Path, cache_dir: Path) -> dict:
         "--dtype", "float32",
         "--judge-backend", "none",
         "--no-save-vectors",
+        # This test reads first_cell_s / warm_cell_mean_s, which only the
+        # per-cell path records; cell fusing is covered by test_cli_e2e.
+        "--fuse-cells", "off",
         "--output-dir", str(out_dir),
         "--compilation-cache-dir", str(cache_dir),
     ]

@@ -150,12 +150,25 @@ def test_prefill_decode_matches_full_forward(cfg, params):
         )
 
 
-def test_decode_ring_merge_matches_full_forward(cfg, params):
+@pytest.mark.parametrize("variant", ["mha", "sliding", "mla"])
+def test_decode_ring_merge_matches_full_forward(variant):
     """Multi-chunk decode: the ring fills up and merges into the main slot
     buffer every ``ring`` steps (runtime.generate's chunked loop calls
     merge_ring the same way); logits must keep matching the full forward
     across merge boundaries — this is the path real 100+-token generations
-    take after the first RING_CHUNK steps."""
+    take after the first RING_CHUNK steps. Parametrized over the three
+    decode-attention families: plain GQA, Gemma-style sliding window
+    (delta_ring masking), and MLA (compressed-row ring)."""
+    if variant == "mha":
+        cfg = tiny_config(n_layers=4)
+    elif variant == "sliding":
+        cfg = tiny_config(n_layers=4, sliding_window=4, sliding_window_pattern=2)
+    else:  # mla
+        cfg = tiny_config(
+            n_layers=4, kv_lora_rank=16, qk_nope_head_dim=8,
+            qk_rope_head_dim=8, v_head_dim=16, q_lora_rank=24,
+        )
+    params = init_params(cfg, jax.random.key(1))
     B, S, ring, steps = 2, 7, 3, 7
     key = jax.random.key(9)
     ids = _ids(key, B, S, cfg.vocab_size)
