@@ -1,0 +1,117 @@
+"""Real-checkpoint smoke: download a small model, run 1 concept x 1 cell,
+and sanity-check that the steered responses are coherent text.
+
+This is the BASELINE.json configs[0] preparation recipe (VERDICT r3 item 5):
+every correctness claim in CI rests on tiny random-init parity models, so the
+moment a real checkpoint is reachable this script closes the loop end to end:
+
+    # with network + HF token (downloads ~2.5 GB):
+    python scripts/real_model_smoke.py --model meta-llama/Llama-3.2-1B-Instruct
+
+    # with a checkpoint already on disk:
+    python scripts/real_model_smoke.py --model /path/to/llama-3.2-1b
+
+Exit code 0 means: the checkpoint loaded through the streaming loader, the
+sweep produced a results.json for the cell, and the responses pass the
+coherence heuristics below (mostly-printable text with real words — a wrong
+rope convention, bad dequant, or broken steering produces byte soup or empty
+strings, which this catches).
+
+``tests/test_real_model.py`` runs the same check under pytest, skipped unless
+``IAT_REAL_CKPT`` points at a local checkpoint directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+
+def resolve_checkpoint(model: str) -> Path:
+    """Local directory as-is; otherwise snapshot-download the HF repo."""
+    path = Path(model)
+    if (path / "config.json").exists():
+        return path
+    from huggingface_hub import snapshot_download  # needs network + token
+
+    return Path(
+        snapshot_download(
+            model, allow_patterns=["*.json", "*.safetensors", "tokenizer*"]
+        )
+    )
+
+
+def coherence_report(responses: list[str]) -> tuple[bool, list[str]]:
+    """Heuristics that random bytes / unscaled-garbage weights fail."""
+    problems = []
+    nonempty = [r for r in responses if r.strip()]
+    if len(nonempty) < max(1, len(responses) // 2):
+        problems.append(
+            f"only {len(nonempty)}/{len(responses)} responses are non-empty"
+        )
+    for i, r in enumerate(nonempty):
+        printable = sum(c.isprintable() or c.isspace() for c in r) / len(r)
+        words = re.findall(r"[A-Za-z]{2,}", r)
+        if printable < 0.9:
+            problems.append(f"response {i} is {printable:.0%} printable")
+        if len(words) < 3:
+            problems.append(f"response {i} has {len(words)} words: {r[:60]!r}")
+    return not problems, problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--model", default="meta-llama/Llama-3.2-1B-Instruct")
+    ap.add_argument("--concept", default="ocean")
+    ap.add_argument("--output-dir", default="results/real_smoke")
+    ap.add_argument("--layer-fraction", type=float, default=0.5)
+    ap.add_argument("--strength", type=float, default=8.0)
+    ap.add_argument("--max-tokens", type=int, default=60)
+    ap.add_argument("--n-trials", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    ckpt = resolve_checkpoint(args.model)
+    print(f"checkpoint: {ckpt}")
+
+    from introspective_awareness_tpu.cli.sweep import main as sweep_main
+
+    rc = sweep_main([
+        "--models", str(ckpt),
+        "--concepts", args.concept,
+        "--layer-fraction", f"{args.layer_fraction}",
+        "--strength", f"{args.strength}",
+        "--n-trials", str(args.n_trials),
+        "--max-tokens", str(args.max_tokens),
+        "--output-dir", args.output_dir,
+        "--judge-backend", "none",
+        "--overwrite",
+    ])
+    if rc != 0:
+        print(f"sweep failed (rc={rc})")
+        return rc
+
+    from introspective_awareness_tpu.metrics import config_dir
+
+    cell = config_dir(
+        args.output_dir, str(ckpt), args.layer_fraction, args.strength
+    )
+    data = json.loads((cell / "results.json").read_text())
+    responses = [r["response"] for r in data["results"]]
+    ok, problems = coherence_report(responses)
+    print(f"\n{len(responses)} responses; sample:\n  {responses[0][:200]!r}")
+    print(f"metrics: hit={data['metrics']['detection_hit_rate']} "
+          f"fa={data['metrics']['detection_false_alarm_rate']}")
+    if not ok:
+        print("COHERENCE CHECK FAILED:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print("coherence check passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

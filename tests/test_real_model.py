@@ -1,0 +1,53 @@
+"""Real-checkpoint smoke test (skipped unless a checkpoint is available).
+
+Set ``IAT_REAL_CKPT=/path/to/checkpoint`` (a HF-format directory with
+config.json + safetensors, e.g. Llama-3.2-1B-Instruct) to run the full
+download-free path: streaming load -> 1 concept x 1 cell sweep -> coherence
+check on the steered responses. ``scripts/real_model_smoke.py`` is the
+runnable recipe this wraps (VERDICT r3 item 5 / BASELINE.json configs[0]).
+
+The coherence heuristics themselves are CI-tested below with crafted inputs,
+so the offline suite still guards the checker's semantics.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+from real_model_smoke import coherence_report  # noqa: E402
+
+
+def test_coherence_report_accepts_real_text():
+    ok, problems = coherence_report([
+        "I notice an intrusive thought about the ocean and waves.",
+        "Yes - I detect something related to water.",
+    ])
+    assert ok, problems
+
+
+def test_coherence_report_rejects_byte_soup():
+    ok, problems = coherence_report(["\x00\x7f\xfe\xfa" * 20, ""])
+    assert not ok
+    assert problems
+
+
+def test_coherence_report_rejects_empty():
+    ok, problems = coherence_report(["", "", ""])
+    assert not ok
+
+
+@pytest.mark.skipif(
+    not os.environ.get("IAT_REAL_CKPT"),
+    reason="IAT_REAL_CKPT not set (no real checkpoint in this environment)",
+)
+def test_real_checkpoint_smoke(tmp_path):
+    from real_model_smoke import main
+
+    assert main([
+        "--model", os.environ["IAT_REAL_CKPT"],
+        "--output-dir", str(tmp_path / "real_smoke"),
+    ]) == 0
