@@ -81,6 +81,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--tp", type=int, default=1, help="Tensor-parallel axis size")
     parser.add_argument("--ep", type=int, default=1, help="Expert-parallel axis size")
     parser.add_argument("--sp", type=int, default=1, help="Sequence-parallel axis size")
+    parser.add_argument("--pp", type=int, default=1,
+                        help="Pipeline-parallel axis size (training/stage "
+                             "pipelining; the eval itself scales via dp/tp)")
     parser.add_argument("--judge-backend", type=str, default="openai",
                         choices=["openai", "on-device", "none"],
                         help="openai = API judge (reference behavior); "

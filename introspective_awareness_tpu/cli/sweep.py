@@ -567,7 +567,18 @@ def main(argv: Optional[list[str]] = None) -> int:
             print(f"no existing model results under {args.output_dir}")
             return 1
 
-    mesh = build_mesh(MeshConfig(dp=args.dp, tp=args.tp, ep=args.ep, sp=args.sp))
+    if args.pp and args.pp > 1:
+        # The eval's generate/capture path scales over dp/tp/ep/sp only; a
+        # pipe axis would silently replicate all sweep compute pp times.
+        print(
+            f"WARNING: --pp {args.pp} builds a pipe axis the sweep does not "
+            "use (pipeline parallelism serves the training path, "
+            "parallel/pipeline.py); those devices will duplicate work. "
+            "Use --dp/--tp/--ep/--sp to scale the eval."
+        )
+    mesh = build_mesh(
+        MeshConfig(dp=args.dp, tp=args.tp, ep=args.ep, sp=args.sp, pp=args.pp)
+    )
     rules = ShardingRules()
     judge = _build_judge(args, mesh, rules)
 

@@ -538,13 +538,16 @@ def forward(
     cache: KVCache | None = None,
     steer: SteerSpec | None = None,
     capture_pos: jax.Array | None = None,  # [B] padded token index to capture
+    h0: jax.Array | None = None,  # [B, S, H] residual input (skips embedding)
+    layer_offset: jax.Array | int = 0,  # global index of params' first layer
     *,
     use_cache: bool = False,
     capture: bool = False,
-    logits_mode: str = "last",  # "last" | "all" | "none"
+    logits_mode: str = "last",  # "last" | "all" | "none" | "hidden"
     is_prefill: bool = False,
 ) -> ForwardResult:
-    """One traced forward covering extraction, prefill, and decode.
+    """One traced forward covering extraction, prefill, decode, and
+    pipeline stages.
 
     - ``use_cache=False``: attention over the current chunk only (the
       extraction path; reference runs this with use_cache=False too,
@@ -554,13 +557,20 @@ def forward(
       which would inflate prefill FLOPs by T/S) while k/v are written into the
       full-length cache.
     - ``use_cache=True`` with S == 1: one decode step over the cache.
+    - ``h0`` + ``layer_offset`` + ``logits_mode="hidden"``: run a SLICE of
+      the trunk on an incoming residual stream and return the outgoing one —
+      the pipeline-parallel stage form (parallel/pipeline.py). The trunk
+      length comes from the parameter stacks, so a stage passes just its
+      local layers; ``layer_offset`` (may be traced, e.g. stage *
+      layers-per-stage) keeps steering layer gating and sliding-window
+      periodicity on GLOBAL layer indices. No-cache only.
     """
     B, S = ids.shape
     dtype = params["embed"].dtype
+    if h0 is not None:
+        assert not use_cache, "pipeline stage form is no-cache"
 
-    h = params["embed"][ids]
-    if cfg.embed_scale:
-        h = (h.astype(jnp.float32) * (cfg.hidden_size**0.5)).astype(dtype)
+    h = embed_tokens(params, cfg, ids) if h0 is None else h0.astype(dtype)
 
     # Rope tables (global + optional local-theta variant for Gemma-3). The
     # yarn attention factor scales cos/sin (DeepSeek; 1.0 otherwise).
@@ -640,10 +650,22 @@ def forward(
         allowed_ring_local = allowed_ring
 
     # Per-layer flags/ids as scan xs (runtime operands, never recompile).
-    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    is_sliding = jnp.array(
-        [cfg.layer_is_sliding(i) for i in range(cfg.n_layers)], jnp.bool_
+    # Sized from the parameter stacks (== cfg.n_layers for a full model, a
+    # slice of it for a pipeline stage) and offset to GLOBAL layer indices;
+    # the sliding flag is the traced form of cfg.layer_is_sliding.
+    kd_local = (
+        params["dense_layers"]["attn_norm"].shape[0]
+        if "dense_layers" in params else 0
     )
+    n_local = kd_local + params["layers"]["attn_norm"].shape[0]
+    layer_ids = (
+        jnp.asarray(layer_offset, jnp.int32)
+        + jnp.arange(n_local, dtype=jnp.int32)
+    )
+    if cfg.sliding_window is None:
+        is_sliding = jnp.zeros((n_local,), jnp.bool_)
+    else:
+        is_sliding = (layer_ids + 1) % cfg.sliding_window_pattern != 0
 
     if steer is None:
         steer = no_steer(B, S, cfg.hidden_size, jnp.float32)
@@ -892,11 +914,11 @@ def forward(
     # Layer groups: the optional dense prefix (DeepSeek first_k_dense) runs
     # before the main trunk; per-layer ids/flags and cache slices follow the
     # global layer numbering, so steering/capture are group-agnostic.
-    kd = cfg.first_k_dense if "dense_layers" in params else 0
+    kd = kd_local
     groups = []
     if kd:
         groups.append((params["dense_layers"], 0, kd, False))
-    groups.append((params["layers"], kd, cfg.n_layers, cfg.is_moe))
+    groups.append((params["layers"], kd, n_local, cfg.is_moe))
 
     new_cache = None
     if read_cache:
@@ -973,19 +995,39 @@ def forward(
         captured = cat("cap") if capture else None  # [L, B, H]
 
     logits = None
-    if logits_mode != "none":
+    if logits_mode == "hidden":
+        logits = h  # outgoing residual stream (pipeline stage form)
+    elif logits_mode != "none":
         hn = h if logits_mode == "all" else h[:, -1:, :]
-        hn = rms_norm(hn, params["final_norm"], cfg.rms_eps, plus1)
-        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-        logits = jnp.einsum(
-            "bsh,hv->bsv", hn, head, preferred_element_type=jnp.float32
-        )
-        if cfg.final_logit_softcap:
-            cap = cfg.final_logit_softcap
-            logits = cap * jnp.tanh(logits / cap)
+        logits = lm_head_logits(params, cfg, hn)
         if logits_mode == "last":
             logits = logits[:, 0, :]  # hn was already sliced to the last position
     return ForwardResult(logits=logits, cache=new_cache, captured=captured)
+
+
+def embed_tokens(params: dict, cfg: ModelConfig, ids: jax.Array) -> jax.Array:
+    """Token embedding (+ Gemma's sqrt(H) scale) — the model's input side,
+    shared by ``forward`` and the pipeline driver (parallel/pipeline.py)."""
+    dtype = params["embed"].dtype
+    h = params["embed"][ids]
+    if cfg.embed_scale:
+        h = (h.astype(jnp.float32) * (cfg.hidden_size**0.5)).astype(dtype)
+    return h
+
+
+def lm_head_logits(params: dict, cfg: ModelConfig, h: jax.Array) -> jax.Array:
+    """Final norm + (tied) LM head + optional softcap over hidden states
+    [B, S, H] — the model's output side, shared by ``forward`` and the
+    pipeline driver."""
+    hn = rms_norm(h, params["final_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum(
+        "bsh,hv->bsv", hn, head, preferred_element_type=jnp.float32
+    )
+    if cfg.final_logit_softcap:
+        cap = cfg.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    return logits
 
 
 def _moe_mlp(x: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:

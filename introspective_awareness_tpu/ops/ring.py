@@ -53,12 +53,9 @@ def _ring_body(q, k, v, qp, kp, kv_valid, *, axis_name, scale, softcap):
     acc = jnp.zeros((B, k.shape[2], NH // k.shape[2], S, D), jnp.float32)
     # The online-softmax state is per-shard data: mark it varying over the
     # ring axis so the loop carry type matches the (varying) step outputs.
-    # pcast is the current spelling; pvary is its deprecated predecessor.
-    pcast = getattr(jax.lax, "pcast", None)
-    if pcast is not None:
-        m, l, acc = pcast((m, l, acc), axis_name, to="varying")
-    else:  # pragma: no cover - older JAX
-        m, l, acc = jax.lax.pvary((m, l, acc), axis_name)
+    from introspective_awareness_tpu.parallel.sharding import mark_varying
+
+    m, l, acc = mark_varying((m, l, acc), axis_name)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 

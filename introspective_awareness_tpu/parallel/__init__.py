@@ -14,6 +14,11 @@ from introspective_awareness_tpu.parallel.mesh import (
     mesh_axis_sizes,
     single_device_mesh,
 )
+from introspective_awareness_tpu.parallel.pipeline import (
+    pipeline_hidden,
+    pipeline_logits,
+    pipeline_next_token_loss,
+)
 from introspective_awareness_tpu.parallel.sharding import (
     ShardingRules,
     logical_to_sharding,
@@ -28,6 +33,9 @@ __all__ = [
     "local_mesh",
     "mesh_axis_sizes",
     "single_device_mesh",
+    "pipeline_hidden",
+    "pipeline_logits",
+    "pipeline_next_token_loss",
     "ShardingRules",
     "logical_to_sharding",
     "shard_params",

@@ -12,10 +12,10 @@ Axis semantics (SURVEY.md §2.3):
 - ``expert`` — EP: MoE experts shard here (defaults to size 1; fold into model TP
   when the subject is dense).
 - ``seq``    — SP/CP: ring-attention sequence sharding (defaults to size 1).
-
-Pipeline parallelism is intentionally not a default axis: over ICI, TP dominates PP
-for the decoder sizes in BASELINE.json; a stage-split path can be layered on later
-without changing this module's API (SURVEY.md §2.3 "PP").
+- ``pipe``   — PP: GPipe-style stage pipelining of the layer stack
+  (parallel/pipeline.py; defaults to size 1). Outermost, so stage-to-stage
+  transfers cross the slowest links / DCN — over ICI, TP dominates PP for
+  the decoder sizes in BASELINE.json, so PP is for multi-slice scale-out.
 """
 
 from __future__ import annotations
@@ -32,8 +32,9 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
 
-AXIS_ORDER = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,18 +52,20 @@ class MeshConfig:
     tp: int | None = 1
     ep: int | None = 1
     sp: int | None = 1
+    pp: int | None = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        known = [x for x in (self.dp, self.tp, self.ep, self.sp) if x is not None]
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
+        axes = (self.dp, self.tp, self.ep, self.sp, self.pp)
+        known = [x for x in axes if x is not None]
         prod = math.prod(known) if known else 1
-        n_none = sum(x is None for x in (self.dp, self.tp, self.ep, self.sp))
+        n_none = sum(x is None for x in axes)
         if n_none == 0:
             if prod != n_devices:
                 raise ValueError(
-                    f"mesh {self.dp}x{self.ep}x{self.sp}x{self.tp} = {prod} "
-                    f"does not match {n_devices} devices"
+                    f"mesh pp={self.pp} x {self.dp}x{self.ep}x{self.sp}x"
+                    f"{self.tp} = {prod} does not match {n_devices} devices"
                 )
-            return (self.dp, self.tp, self.ep, self.sp)
+            return axes  # type: ignore[return-value]
         if n_devices % prod != 0:
             raise ValueError(
                 f"{n_devices} devices not divisible by fixed axes product {prod}"
@@ -70,7 +73,7 @@ class MeshConfig:
         fill = n_devices // prod
         # Exactly one unknown axis gets the remaining devices; extra unknowns get 1.
         out = []
-        for x in (self.dp, self.tp, self.ep, self.sp):
+        for x in axes:
             if x is None:
                 out.append(fill)
                 fill = 1
@@ -96,14 +99,14 @@ def build_mesh(
         # enumeration order does not guarantee the innermost 'model' axis lands
         # on physically adjacent chips. create_device_mesh consults the slice
         # topology so TP collectives actually ride neighbor ICI links.
-        dp, tp, ep, sp = config.resolve(len(jax.devices()))
+        dp, tp, ep, sp, pp = config.resolve(len(jax.devices()))
         from jax.experimental import mesh_utils
 
-        arr = mesh_utils.create_device_mesh((dp, ep, sp, tp))
+        arr = mesh_utils.create_device_mesh((pp, dp, ep, sp, tp))
     else:
         devices = list(devices)
-        dp, tp, ep, sp = config.resolve(len(devices))
-        arr = np.array(devices).reshape(dp, ep, sp, tp)
+        dp, tp, ep, sp, pp = config.resolve(len(devices))
+        arr = np.array(devices).reshape(pp, dp, ep, sp, tp)
     return Mesh(arr, AXIS_ORDER)
 
 

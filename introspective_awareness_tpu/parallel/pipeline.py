@@ -1,0 +1,198 @@
+"""GPipe-style pipeline parallelism over the mesh ``pipe`` axis.
+
+The layer stack splits into P contiguous stages (each device along ``pipe``
+holds n_layers / P layers of the scan-stacked parameters); microbatches
+stream through the stages while activations hop stage-to-stage with
+``lax.ppermute`` — the classic collective-permute pipeline (SURVEY.md §2.3
+"PP"; the reference's multi-GPU story is accelerate's device_map layer
+placement, model_utils.py:107, which is the same stage split executed
+sequentially with no microbatch overlap).
+
+Each stage runs the REAL model code: ``models.transformer.forward`` in its
+stage form (``h0`` in, ``logits_mode="hidden"`` out, ``layer_offset`` keeping
+steering gates and sliding-window periodicity on global layer indices), so
+every architecture quirk the full forward supports works identically under
+PP. Embedding and the LM head run outside the pipelined trunk under plain
+GSPMD (they are replicated over ``pipe``; batch/vocab shard over the auto
+axes as usual).
+
+Scope: the no-cache forward (training / teacher-forced scoring). Decode
+serves latency-bound evaluation and scales via dp/tp/ep instead — a decode
+bubble of P-1 single-token steps per token would dominate at the eval's
+sequence lengths.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from typing import TYPE_CHECKING
+
+from introspective_awareness_tpu.models.config import ModelConfig
+from introspective_awareness_tpu.parallel.mesh import PIPE_AXIS
+from introspective_awareness_tpu.parallel.sharding import mark_varying
+
+if TYPE_CHECKING:  # models.transformer imports parallel.sharding; keep the
+    from introspective_awareness_tpu.models.transformer import SteerSpec
+    # runtime import lazy (inside the functions) to avoid the cycle.
+
+
+def _check(cfg: ModelConfig, mesh: Mesh, batch: int, n_micro: int) -> int:
+    n_stages = mesh.shape[PIPE_AXIS]
+    if cfg.first_k_dense:
+        raise NotImplementedError(
+            "pipeline stages require a single homogeneous layer stack "
+            "(first_k_dense models keep dp/tp/ep)"
+        )
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pipe={n_stages}"
+        )
+    if batch % n_micro:
+        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
+    return n_stages
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "n_micro"))
+def pipeline_hidden(
+    params: dict,
+    cfg: ModelConfig,
+    ids: jax.Array,  # [B, S]
+    mask: jax.Array,  # [B, S]
+    mesh: Mesh,
+    n_micro: int,
+    steer: SteerSpec | None = None,
+) -> jax.Array:
+    """Trunk output hidden states [B, S, H], trunk pipelined over ``pipe``.
+
+    The pipeline schedule runs ``n_micro + P - 1`` ticks: at tick t, stage p
+    processes microbatch ``t - p`` (stages idle in the fill/drain bubble —
+    the bubble fraction is (P-1)/(n_micro+P-1), so pick n_micro >= P).
+    Stage-to-stage sends are a single ring ``ppermute`` per tick.
+
+    ``steer`` composes as in ``forward``: the target layer is a GLOBAL index
+    (a runtime operand), and ``layer_offset`` makes each stage's gate fire
+    exactly when it owns that layer.
+    """
+    from introspective_awareness_tpu.models.transformer import (
+        SteerSpec,
+        embed_tokens,
+        forward,
+        make_positions,
+        no_steer,
+    )
+
+    n_stages = _check(cfg, mesh, ids.shape[0], n_micro)
+    B, S = ids.shape
+    H = cfg.hidden_size
+    mb = B // n_micro
+
+    h0 = embed_tokens(params, cfg, ids)
+    h0m = h0.reshape(n_micro, mb, S, H)
+    maskm = mask.reshape(n_micro, mb, S)
+    posm = make_positions(mask).reshape(n_micro, mb, S)
+    if steer is None:
+        steer = no_steer(B, S, H)
+    # Broadcast per-example operands to [B, ...] then microbatch them.
+    steerm = SteerSpec(
+        layer_idx=jnp.broadcast_to(
+            jnp.asarray(steer.layer_idx, jnp.int32), (B,)
+        ).reshape(n_micro, mb),
+        strength=jnp.broadcast_to(
+            jnp.asarray(steer.strength, jnp.float32), (B,)
+        ).reshape(n_micro, mb),
+        vectors=jnp.asarray(steer.vectors).reshape(n_micro, mb, H),
+        pos_mask=jnp.asarray(steer.pos_mask).reshape(n_micro, mb, S),
+    )
+    trunk = params["layers"]
+    others = {k: v for k, v in params.items() if k != "layers"}
+    l_per_stage = cfg.n_layers // n_stages
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        axis_names=frozenset({PIPE_AXIS}),
+        # The trunk's leading (layer) dim splits over pipe; everything else
+        # is replicated over pipe and left to GSPMD on the auto axes.
+        in_specs=(
+            jax.tree.map(lambda _: P(PIPE_AXIS), trunk),
+            P(), P(), P(), jax.tree.map(lambda _: P(), others),
+            jax.tree.map(lambda _: P(), steerm),
+        ),
+        out_specs=P(),
+    )
+    def run(trunk_local, h0m, maskm, posm, others, steerm):
+        p = lax.axis_index(PIPE_AXIS)
+        stage_params = dict(others, layers=trunk_local)
+        offset = p * l_per_stage
+
+        def tick(carry, t):
+            buf, outs = carry
+            mb_ix = jnp.clip(t - p, 0, n_micro - 1)
+            x = jnp.where(p == 0, h0m[jnp.clip(t, 0, n_micro - 1)], buf)
+            y = forward(
+                stage_params, cfg, jnp.zeros((mb, S), jnp.int32),
+                maskm[mb_ix], posm[mb_ix],
+                steer=jax.tree.map(lambda a: a[mb_ix], steerm),
+                h0=x, layer_offset=offset,
+                logits_mode="hidden",
+            ).logits
+            active = ((t - p) >= 0) & ((t - p) < n_micro)
+            last = p == n_stages - 1
+            outs = jnp.where(active & last, outs.at[mb_ix].set(y), outs)
+            # Ring send: stage p's output becomes stage p+1's next input.
+            buf = lax.ppermute(
+                y, PIPE_AXIS,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)],
+            )
+            return (buf, outs), None
+
+        buf0 = jnp.zeros((mb, S, H), h0m.dtype)
+        outs0 = jnp.zeros((n_micro, mb, S, H), h0m.dtype)
+        # The scan carry is per-stage data: mark it varying over the pipe
+        # axis so the carry type matches the (varying) tick outputs (same
+        # convention as ops/ring.py's online-softmax state).
+        buf0, outs0 = mark_varying((buf0, outs0), PIPE_AXIS)
+        (_, outs), _ = lax.scan(
+            tick, (buf0, outs0), jnp.arange(n_micro + n_stages - 1)
+        )
+        # Only the last stage holds real outputs; replicate via masked psum.
+        return lax.psum(
+            jnp.where(p == n_stages - 1, outs, jnp.zeros_like(outs)),
+            PIPE_AXIS,
+        )
+
+    outs = run(trunk, h0m, maskm, posm, others, steerm)
+    return outs.reshape(B, S, H)
+
+
+def pipeline_logits(
+    params: dict, cfg: ModelConfig, ids: jax.Array, mask: jax.Array,
+    mesh: Mesh, n_micro: int, steer: SteerSpec | None = None,
+) -> jax.Array:
+    """Full-vocab logits [B, S, V] with the trunk pipelined."""
+    from introspective_awareness_tpu.models.transformer import lm_head_logits
+
+    h = pipeline_hidden(params, cfg, ids, mask, mesh, n_micro, steer)
+    return lm_head_logits(params, cfg, h)
+
+
+def pipeline_next_token_loss(
+    params: dict, cfg: ModelConfig, ids: jax.Array, mask: jax.Array,
+    mesh: Mesh, n_micro: int,
+) -> jax.Array:
+    """Pipelined counterpart of ``training.train.next_token_loss`` —
+    identical math, trunk stages overlapped over microbatches."""
+    logits = pipeline_logits(params, cfg, ids, mask, mesh, n_micro)[:, :-1, :]
+    targets = ids[:, 1:]
+    valid = (mask[:, 1:] * mask[:, :-1]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, targets[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)

@@ -106,6 +106,21 @@ def shard_params(params: Any, axes: Any, mesh: Mesh, rules: ShardingRules) -> An
     return jax.tree.map(_put, params, axes, is_leaf=lambda x: x is None)
 
 
+def mark_varying(tree, axis_name: str):
+    """Mark a pytree as varying over a manual (shard_map) mesh axis.
+
+    ``pcast`` is the current spelling; ``pvary`` its deprecated predecessor —
+    one guarded call site shared by ring attention and the pipeline instead
+    of diverging copies.
+    """
+    import jax
+
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(tree, axis_name, to="varying")
+    return jax.lax.pvary(tree, axis_name)  # pragma: no cover - older JAX
+
+
 def with_sharding_constraint(
     x: jax.Array, logical_axes: tuple[str | None, ...], mesh: Mesh, rules: ShardingRules
 ) -> jax.Array:

@@ -62,7 +62,9 @@ def test_artifact_layout(sweep_out):
     assert (model_dir / "vectors" / "layer_0.75" / "Trees.json").exists()
     assert (model_dir / "sweep_summary.txt").exists()
     manifest = json.loads((model_dir / "run_manifest.json").read_text())
-    assert manifest["mesh"] == {"data": 2, "expert": 1, "seq": 1, "model": 4}
+    assert manifest["mesh"] == {
+        "pipe": 1, "data": 2, "expert": 1, "seq": 1, "model": 4
+    }
     assert "extraction_s" in manifest["timings"]
 
 

@@ -23,7 +23,9 @@ def test_devices_virtualized():
 
 def test_mesh_resolution():
     mesh = build_mesh(MeshConfig(dp=2, tp=4))
-    assert mesh_axis_sizes(mesh) == {"data": 2, "expert": 1, "seq": 1, "model": 4}
+    assert mesh_axis_sizes(mesh) == {
+        "pipe": 1, "data": 2, "expert": 1, "seq": 1, "model": 4
+    }
 
 
 def test_mesh_infer_dp():
