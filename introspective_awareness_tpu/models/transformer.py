@@ -92,6 +92,7 @@ class KVCache(NamedTuple):
     rk: jax.Array  # [L, R, B, KVH*KD] — decode ring (append-only)
     rv: jax.Array  # [L, R, B, KVH*VD]
     rpos: jax.Array  # [B, R] int32 — rope positions of ring slots
+    rvalid: jax.Array  # [B, R] bool — real-token ring slots (pads False)
     rlen: jax.Array  # int32 scalar — next ring write slot
 
 
@@ -118,9 +119,11 @@ def merge_ring(cache: KVCache, cfg: ModelConfig) -> KVCache:
         )
     else:
         new_v = cache.v
-    valid = jnp.arange(RR, dtype=jnp.int32)[None, :] < cache.rlen
+    valid = (
+        jnp.arange(RR, dtype=jnp.int32)[None, :] < cache.rlen
+    ) & cache.rvalid
     new_slot_mask = lax.dynamic_update_slice(
-        cache.slot_mask, jnp.broadcast_to(valid, (B, RR)), (0, cache.length)
+        cache.slot_mask, valid, (0, cache.length)
     )
     new_positions = lax.dynamic_update_slice(
         cache.positions, cache.rpos, (0, cache.length)
@@ -128,7 +131,8 @@ def merge_ring(cache: KVCache, cfg: ModelConfig) -> KVCache:
     return KVCache(
         k=new_k, v=new_v, slot_mask=new_slot_mask, positions=new_positions,
         length=cache.length + cache.rlen,
-        rk=cache.rk, rv=cache.rv, rpos=cache.rpos, rlen=jnp.int32(0),
+        rk=cache.rk, rv=cache.rv, rpos=cache.rpos, rvalid=cache.rvalid,
+        rlen=jnp.int32(0),
     )
 
 
@@ -152,6 +156,7 @@ def init_cache(
         rk=jnp.zeros((L, ring_len, batch, kvh * kd), dtype),
         rv=jnp.zeros((L, ring_len, batch, kvh * vd), dtype),
         rpos=jnp.zeros((batch, ring_len), jnp.int32),
+        rvalid=jnp.zeros((batch, ring_len), jnp.bool_),
         rlen=jnp.int32(0),
     )
 
@@ -592,7 +597,7 @@ def forward(
     causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
     allowed = causal[None, :, :] & attn_mask[:, None, :].astype(jnp.bool_)
     read_cache = use_cache and not is_prefill  # prefill never reads old slots
-    new_slot_mask = new_positions = new_rpos = None
+    new_slot_mask = new_positions = new_rpos = new_rvalid = None
     length = rlen = None
     allowed_old = allowed_ring = None
     if use_cache:
@@ -617,9 +622,10 @@ def forward(
                 cache.slot_mask[:, None, :], (B, S, cache.k.shape[2])
             )
             ridx = jnp.arange(RR, dtype=jnp.int32)
-            written = jnp.broadcast_to(
-                (ridx[None, None, :] < rlen), (B, S, RR)
+            written = (
+                (ridx[None, None, :] < rlen) & cache.rvalid[:, None, :]
             )
+            written = jnp.broadcast_to(written, (B, S, RR))
             chunk_tok = lax.dynamic_update_slice(
                 jnp.zeros((B, RR), jnp.bool_), attn_mask.astype(jnp.bool_),
                 (0, rlen),
@@ -629,6 +635,9 @@ def forward(
             )
             allowed_ring = written | (chunk_tok[:, None, :] & causal_ring)
             new_rpos = lax.dynamic_update_slice(cache.rpos, positions, (0, rlen))
+            new_rvalid = lax.dynamic_update_slice(
+                cache.rvalid, attn_mask.astype(jnp.bool_), (0, rlen)
+            )
 
     if cfg.sliding_window is not None:
         delta = positions[:, :, None] - positions[:, None, :]  # [B, S, S]
@@ -952,7 +961,8 @@ def forward(
         new_cache = KVCache(
             k=cache.k, v=cache.v, slot_mask=cache.slot_mask,
             positions=cache.positions, length=length,
-            rk=new_rk, rv=new_rv, rpos=new_rpos, rlen=rlen + S,
+            rk=new_rk, rv=new_rv, rpos=new_rpos, rvalid=new_rvalid,
+            rlen=rlen + S,
         )
         captured = jnp.stack(caps) if capture else None
     else:
@@ -990,6 +1000,7 @@ def forward(
                 rk=cache.rk,
                 rv=cache.rv,
                 rpos=cache.rpos,
+                rvalid=cache.rvalid,
                 rlen=cache.rlen,
             )
         captured = cat("cap") if capture else None  # [L, B, H]

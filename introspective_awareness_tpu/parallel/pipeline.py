@@ -186,13 +186,10 @@ def pipeline_next_token_loss(
     params: dict, cfg: ModelConfig, ids: jax.Array, mask: jax.Array,
     mesh: Mesh, n_micro: int,
 ) -> jax.Array:
-    """Pipelined counterpart of ``training.train.next_token_loss`` —
-    identical math, trunk stages overlapped over microbatches."""
-    logits = pipeline_logits(params, cfg, ids, mask, mesh, n_micro)[:, :-1, :]
-    targets = ids[:, 1:]
-    valid = (mask[:, 1:] * mask[:, :-1]).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(
-        logp, targets[..., None].astype(jnp.int32), axis=-1
-    )[..., 0]
-    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+    """Pipelined counterpart of ``training.train.next_token_loss`` — the
+    same ``loss_from_logits`` definition, trunk stages overlapped over
+    microbatches."""
+    from introspective_awareness_tpu.training.train import loss_from_logits
+
+    logits = pipeline_logits(params, cfg, ids, mask, mesh, n_micro)
+    return loss_from_logits(logits, ids, mask)

@@ -113,8 +113,6 @@ def mark_varying(tree, axis_name: str):
     one guarded call site shared by ring attention and the pipeline instead
     of diverging copies.
     """
-    import jax
-
     pcast = getattr(jax.lax, "pcast", None)
     if pcast is not None:
         return pcast(tree, axis_name, to="varying")

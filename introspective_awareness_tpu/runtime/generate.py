@@ -54,22 +54,22 @@ class GenSpec(NamedTuple):
     pad_id: jax.Array  # int32 scalar
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
-def generate_tokens(
-    params: dict,
-    cfg: ModelConfig,
-    ids: jax.Array,  # [B, S] left-padded
-    mask: jax.Array,  # [B, S]
-    spec: GenSpec,
-    *,
-    max_new_tokens: int,
-) -> jax.Array:
-    """Returns generated token ids ``[B, max_new_tokens]`` (pad after EOS)."""
-    B, S = ids.shape
-    positions = make_positions(mask)
-    true_len = mask.sum(axis=1).astype(jnp.int32)
-    dtype = params["embed"].dtype
+def _chunk_plan(max_new_tokens: int) -> tuple[int, int]:
+    """(n_chunks, chunk_size) for the decode loop. Chunks are evened out
+    (99 steps -> 7x15, not 7x16): every chunk runs in full, so the final
+    chunk's overrun past the step count is wasted forward passes. EOS
+    early-exit is likewise chunk-granular — up to ch-1 steps run after the
+    last row finishes, the price of keeping per-step ring appends off the
+    big slot buffer."""
+    steps_total = max_new_tokens - 1
+    n_chunks = -(-steps_total // RING_CHUNK) if steps_total else 0
+    ch = -(-steps_total // n_chunks) if n_chunks else 1
+    return n_chunks, ch
 
+
+def _steer_specs(spec: GenSpec, mask: jax.Array) -> tuple[SteerSpec, SteerSpec]:
+    """(prompt-phase, decode-phase) steering from the padded-coords spec."""
+    B, S = mask.shape
     prompt_pos_mask = (
         (jnp.arange(S)[None, :] >= spec.steer_start[:, None]) & (mask > 0)
     ).astype(jnp.float32)
@@ -82,24 +82,24 @@ def generate_tokens(
         spec.steer_vectors,
         jnp.ones((B, 1), jnp.float32),
     )
+    return steer_prompt, steer_decode
 
-    steps_total = max_new_tokens - 1
-    n_chunks = -(-steps_total // RING_CHUNK) if steps_total else 0
-    # Even the chunks out (99 steps -> 7x15, not 7x16): every chunk runs in
-    # full, so the final chunk's overrun past steps_total is wasted forward
-    # passes. EOS early-exit is likewise chunk-granular — up to ch-1 steps
-    # run after the last row finishes, the price of keeping per-step ring
-    # appends off the big slot buffer.
-    ch = -(-steps_total // n_chunks) if n_chunks else 1
-    # The main slot buffer holds the prompt plus every merged chunk; the last
-    # chunk may overrun past max_new (those slots are written but the outer
-    # loop ends before anything could read them).
-    cache = init_cache(cfg, B, S + n_chunks * ch, dtype, ring_len=ch)
-    r = forward(
-        params, cfg, ids, mask, positions,
-        cache=cache, steer=steer_prompt, use_cache=True, logits_mode="last",
-        is_prefill=True,
-    )
+
+def _sample_and_decode(
+    params: dict,
+    cfg: ModelConfig,
+    cache,
+    logits0: jax.Array,  # [B, V] last-position logits after the prompt
+    steer_decode: SteerSpec,
+    spec: GenSpec,
+    true_len: jax.Array,  # [B] total real context length (incl. any prefix)
+    max_new_tokens: int,
+    n_chunks: int,
+    ch: int,
+) -> jax.Array:
+    """Sample the first token, then run the chunked early-exit decode loop
+    (shared by the plain and shared-prefix entry points)."""
+    B = logits0.shape[0]
 
     def sample(logits, key):
         # categorical(logits / T) == argmax(logits + T * gumbel) for T > 0,
@@ -112,7 +112,7 @@ def generate_tokens(
         return jnp.argmax(logits + temp * g, axis=-1).astype(jnp.int32)
 
     key, sub = jax.random.split(spec.rng)
-    tok0 = sample(r.logits, sub)
+    tok0 = sample(logits0, sub)
     done0 = jnp.isin(tok0, spec.eos_ids)
 
     # Early-exit decode: the outer (per-chunk) while_loop stops as soon as
@@ -152,9 +152,130 @@ def generate_tokens(
         )
         return cc + 1, merge_ring(cache, cfg), prev, done, key, tokens
 
-    if steps_total > 0:
-        carry = (jnp.int32(0), r.cache, tok0, done0, key, tokens0)
+    if max_new_tokens > 1:
+        carry = (jnp.int32(0), cache, tok0, done0, key, tokens0)
         _, _, _, _, _, tokens = lax.while_loop(chunk_cond, chunk_body, carry)
     else:
         tokens = tokens0
     return tokens[:, :max_new_tokens]
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+def generate_tokens(
+    params: dict,
+    cfg: ModelConfig,
+    ids: jax.Array,  # [B, S] left-padded
+    mask: jax.Array,  # [B, S]
+    spec: GenSpec,
+    *,
+    max_new_tokens: int,
+) -> jax.Array:
+    """Returns generated token ids ``[B, max_new_tokens]`` (pad after EOS)."""
+    B, S = ids.shape
+    positions = make_positions(mask)
+    true_len = mask.sum(axis=1).astype(jnp.int32)
+    dtype = params["embed"].dtype
+
+    steer_prompt, steer_decode = _steer_specs(spec, mask)
+    n_chunks, ch = _chunk_plan(max_new_tokens)
+    # The main slot buffer holds the prompt plus every merged chunk; the last
+    # chunk may overrun past max_new (those slots are written but the outer
+    # loop ends before anything could read them).
+    cache = init_cache(cfg, B, S + n_chunks * ch, dtype, ring_len=ch)
+    r = forward(
+        params, cfg, ids, mask, positions,
+        cache=cache, steer=steer_prompt, use_cache=True, logits_mode="last",
+        is_prefill=True,
+    )
+    return _sample_and_decode(
+        params, cfg, r.cache, r.logits, steer_decode, spec, true_len,
+        max_new_tokens, n_chunks, ch,
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+def generate_tokens_prefix(
+    params: dict,
+    cfg: ModelConfig,
+    prefix_ids: jax.Array,  # [P0] — the SHARED unpadded prompt prefix
+    suffix_ids: jax.Array,  # [B, Ss] — left-padded per-row suffixes
+    suffix_mask: jax.Array,  # [B, Ss]
+    spec: GenSpec,  # steer_start in PADDED SUFFIX coords
+    *,
+    max_new_tokens: int,
+) -> jax.Array:
+    """``generate_tokens`` with shared-prefix KV caching.
+
+    The sweep's trial prompts share the long 4-turn preamble verbatim (only
+    the trailing "Trial N" turn differs), and steering starts inside that
+    trailing turn — so the prefix KV is computed ONCE at batch 1 and
+    broadcast into every row's cache, cutting prefill FLOPs by ~B x for the
+    shared part. Eligibility (identical prefix; per-row steering starts at
+    or after the split, or strength 0) is the caller's responsibility
+    (runtime.runner checks it).
+
+    The slot-based cache makes this exact, not approximate: prefix rows
+    occupy slots [0, P0) with positions 0..P0-1 for every row, the suffix is
+    a ring continuation chunk (left-padded; pad slots stay invalid via
+    ``rvalid``), and decode proceeds as usual.
+    """
+    B, Ss = suffix_ids.shape
+    P0 = prefix_ids.shape[0]
+    L = cfg.n_layers
+    dtype = params["embed"].dtype
+
+    # 1) Prefill the shared prefix once at batch 1 (unsteerable by
+    #    eligibility; pass no steer).
+    pcache = init_cache(cfg, 1, P0, dtype)
+    r0 = forward(
+        params, cfg, prefix_ids[None], jnp.ones((1, P0), jnp.int32),
+        jnp.arange(P0, dtype=jnp.int32)[None],
+        cache=pcache, use_cache=True, logits_mode="none", is_prefill=True,
+    )
+
+    n_chunks, ch = _chunk_plan(max_new_tokens)
+    # The suffix chunk needs an Ss-slot ring; decode then swaps in a fresh
+    # ch-slot ring (below) so per-step ring reads/appends stay small.
+    T = P0 + Ss + n_chunks * ch
+    cache = init_cache(cfg, B, T, dtype, ring_len=Ss)
+
+    # 2) Broadcast the prefix KV into every row's slots [0, P0).
+    def put_prefix(dst, src):
+        rows = jnp.broadcast_to(src[:, :1], (L, B) + src.shape[2:])
+        return lax.dynamic_update_slice(
+            dst, rows.astype(dst.dtype), (0, 0, 0, 0, 0)
+        )
+
+    cache = cache._replace(
+        k=put_prefix(cache.k, r0.cache.k),
+        v=put_prefix(cache.v, r0.cache.v) if cache.v.shape[-1] else cache.v,
+        slot_mask=cache.slot_mask.at[:, :P0].set(True),
+        positions=cache.positions.at[:, :P0].set(
+            jnp.arange(P0, dtype=jnp.int32)[None]
+        ),
+        length=jnp.int32(P0),
+    )
+
+    # 3) Per-row suffixes as one steered continuation chunk (ring path).
+    steer_prompt, steer_decode = _steer_specs(spec, suffix_mask)
+    suffix_pos = P0 + make_positions(suffix_mask)
+    r = forward(
+        params, cfg, suffix_ids, suffix_mask, suffix_pos,
+        cache=cache, steer=steer_prompt, use_cache=True, logits_mode="last",
+    )
+    cache = merge_ring(r.cache, cfg)
+    # Swap the (suffix-sized) ring for a decode-sized one: decode attention
+    # reads and appends scale with ring capacity, so carrying Ss slots
+    # through every decode step would cost ~Ss/ch x the ring traffic.
+    cache = cache._replace(
+        rk=jnp.zeros((L, ch, B, cache.rk.shape[-1]), dtype),
+        rv=jnp.zeros((L, ch, B, cache.rv.shape[-1]), dtype),
+        rpos=jnp.zeros((B, ch), jnp.int32),
+        rvalid=jnp.zeros((B, ch), jnp.bool_),
+        rlen=jnp.int32(0),
+    )
+    true_len = P0 + suffix_mask.sum(axis=1).astype(jnp.int32)
+    return _sample_and_decode(
+        params, cfg, cache, r.logits, steer_decode, spec, true_len,
+        max_new_tokens, n_chunks, ch,
+    )

@@ -39,6 +39,23 @@ def make_optimizer(
     return optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay)
 
 
+def loss_from_logits(
+    logits: jax.Array,  # [B, S, V]
+    ids: jax.Array,  # [B, S] left-padded
+    mask: jax.Array,  # [B, S]
+) -> jax.Array:
+    """Masked next-token NLL from full-sequence logits — THE loss
+    definition, shared by the plain and pipelined (parallel.pipeline)
+    train paths so they cannot silently diverge."""
+    logits = logits[:, :-1, :]  # predict next token
+    targets = ids[:, 1:]
+    # A target is valid when both it and its predecessor are real tokens.
+    valid = (mask[:, 1:] * mask[:, :-1]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
 def next_token_loss(
     params: Any,
     cfg: ModelConfig,
@@ -48,13 +65,7 @@ def next_token_loss(
     """Mean cross-entropy of token t+1 given tokens <= t (pads masked out)."""
     positions = make_positions(mask)
     r = forward(params, cfg, ids, mask, positions, logits_mode="all")
-    logits = r.logits[:, :-1, :]  # predict next token
-    targets = ids[:, 1:]
-    # A target is valid when both it and its predecessor are real tokens.
-    valid = (mask[:, 1:] * mask[:, :-1]).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
-    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+    return loss_from_logits(r.logits, ids, mask)
 
 
 def init_train_state(

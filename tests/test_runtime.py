@@ -91,6 +91,72 @@ def test_sampling_determinism(runner):
     assert a != c  # overwhelmingly likely for 8 byte-tokens x 3 prompts
 
 
+def test_prefix_cache_matches_full_prefill(monkeypatch):
+    """Shared-prefix KV caching: prompts opening with the same preamble and
+    steering only after it must generate token-identical output (temp 0) to
+    the full-prefill path — and the prefix path must actually engage."""
+    import introspective_awareness_tpu.runtime.runner as rm
+
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.key(0))
+    tok = ByteTokenizer()
+    common = "The quick brown fox jumps over the lazy dog. " * 4
+    prompts = [common + f"Trial {i}: Do you detect an injected thought?"
+               for i in (1, 2, 33)]
+    rng = np.random.default_rng(3)
+    vecs = [rng.standard_normal(cfg.hidden_size).astype(np.float32)
+            for _ in prompts]
+    starts = [len(tok.encode(p)) - 10 for p in prompts]
+
+    calls = {"prefix": 0}
+    orig = rm.generate_tokens_prefix
+
+    def spy(*a, **k):
+        calls["prefix"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(rm, "generate_tokens_prefix", spy)
+
+    def gen(prefix_cache):
+        r = ModelRunner(
+            params, cfg, ByteTokenizer(), model_name="tiny",
+            seq_multiple=16, batch_multiple=4, prefix_cache=prefix_cache,
+            prefix_min=32,
+        )
+        return r.generate_batch_with_multi_steering(
+            prompts, layer_idx=2, steering_vectors=vecs, strength=6.0,
+            max_new_tokens=20, temperature=0.0,
+            steering_start_positions=starts,
+        )
+
+    off = gen(prefix_cache=False)
+    assert calls["prefix"] == 0
+    on = gen(prefix_cache=True)
+    assert calls["prefix"] == 1, "prefix path did not engage"
+    assert on == off
+
+    # Steering inside the prefix region disables the path (falls back).
+    r = ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4, prefix_min=32,
+    )
+    out = r.generate_batch_with_multi_steering(
+        prompts, layer_idx=2, steering_vectors=vecs, strength=6.0,
+        max_new_tokens=8, temperature=0.0,
+        steering_start_positions=[1] * len(prompts),
+    )
+    assert calls["prefix"] == 1  # unchanged: fell back to full prefill
+    assert len(out) == len(prompts)
+
+    # Strength 0 (control trials) is eligible regardless of starts.
+    r.generate_batch_with_multi_steering(
+        prompts, layer_idx=2, steering_vectors=vecs, strength=0.0,
+        max_new_tokens=8, temperature=0.0,
+        steering_start_positions=[1] * len(prompts),
+    )
+    assert calls["prefix"] == 2
+
+
 def test_generate_chunk_size_invariance(runner, monkeypatch):
     """Greedy generation is identical whether the decode ring merges every 3
     steps or never (single chunk) — chunking is an execution detail, not a
