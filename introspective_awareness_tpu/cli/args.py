@@ -94,6 +94,11 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=["xla", "flash"],
                         help="Attention for prefill/extraction: fused einsum "
                              "(xla) or the Pallas flash kernel")
+    parser.add_argument("--kv-cache-dtype", type=str, default="model",
+                        choices=["model", "fp8"],
+                        help="KV cache storage dtype: the model dtype, or "
+                             "float8_e4m3fn (halves the dominant decode HBM "
+                             "stream at a small logit perturbation)")
     parser.add_argument("--debug-nans", action="store_true",
                         help="Sanitizer mode: raise on NaN/Inf inside jit")
     parser.add_argument("--profile-dir", type=str, default=None,

@@ -128,10 +128,14 @@ def load_subject(name: str, args, mesh, rules):
     ]
 
     def finalize(runner):
-        if getattr(args, "attn_impl", "xla") != "xla":
-            import dataclasses
+        import dataclasses
 
+        if getattr(args, "attn_impl", "xla") != "xla":
             runner.cfg = dataclasses.replace(runner.cfg, attn_impl=args.attn_impl)
+        if getattr(args, "kv_cache_dtype", "model") != "model":
+            runner.cfg = dataclasses.replace(
+                runner.cfg, kv_cache_dtype=args.kv_cache_dtype
+            )
         if getattr(args, "quantization", None):
             from introspective_awareness_tpu.models.quant import quantize_params
 

@@ -77,6 +77,12 @@ class ModelConfig:
     # kernel for the S>1 paths (prefill / extraction). Decode (S=1) always
     # uses the einsum path — a 1-row MXU tile gains nothing from the kernel.
     attn_impl: str = "xla"
+    # KV cache storage dtype: "model" (the parameter dtype) or "fp8"
+    # (float8_e4m3fn payload, converted back on read). Decode is KV-read
+    # bandwidth-bound at large batch, so fp8 halves the dominant HBM stream;
+    # e4m3's ~2 significant digits measurably perturb logits, so it is
+    # opt-in (--kv-cache-dtype) and parity tests run with "model".
+    kv_cache_dtype: str = "model"
     rope_scaling: RopeScaling | None = None
     max_position: int = 8192
     # MoE (0 experts = dense MLP)

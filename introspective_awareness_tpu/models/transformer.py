@@ -143,10 +143,14 @@ def init_cache(
     """MHA caches per-head k/v; MLA caches one row of compressed-kv + shared
     rope key per token (``v`` is unused and kept zero-width). ``max_len``
     sizes the prefill part; ``ring_len`` the decode ring (the number of
-    decode steps that will append)."""
+    decode steps that will append). ``cfg.kv_cache_dtype="fp8"`` stores the
+    payload as float8_e4m3fn (writers .astype into the buffers; readers
+    convert back — see the attention fns)."""
     kvh, kd = cfg.cache_kv_heads, cfg.cache_k_dim
     vd = 0 if cfg.is_mla else cfg.head_dim
     L = cfg.n_layers
+    if cfg.kv_cache_dtype == "fp8":
+        dtype = jnp.float8_e4m3fn
     return KVCache(
         k=jnp.zeros((L, batch, max_len, kvh, kd), dtype),
         v=jnp.zeros((L, batch, max_len, kvh, vd), dtype),
@@ -493,6 +497,12 @@ def _attention_decode(
     groups = NH // KVH
     qg = q.reshape(B, S, KVH, groups, D)
     scale = cfg.query_scale if cfg.query_scale is not None else D**-0.5
+    # fp8-stored caches convert back at the dot (the convert fuses into the
+    # operand read; the HBM stream stays fp8-sized).
+    k_old, v_old, rk, rv = (
+        a.astype(q.dtype) if a.dtype != q.dtype else a
+        for a in (k_old, v_old, rk, rv)
+    )
 
     def part(eq, k, m):
         s = jnp.einsum(eq, qg, k, preferred_element_type=jnp.float32) * scale
@@ -810,8 +820,8 @@ def forward(
             # ring first; frozen prefill slots ⊕ ring share one softmax.
             wkv_b = W(lp["wkv_b"]).reshape(R, NH, ND + VD)
             wk_b, wv_b = wkv_b[..., :ND], wkv_b[..., ND:]
-            cc_old = xs["ck"][:, :, 0, :R]
-            kr_old = xs["ck"][:, :, 0, R:]
+            cc_old = xs["ck"][:, :, 0, :R].astype(x.dtype)
+            kr_old = xs["ck"][:, :, 0, R:].astype(x.dtype)
             q_abs = jnp.einsum(
                 "bsnd,rnd->bsnr", q_nope, wk_b, preferred_element_type=jnp.float32
             ).astype(x.dtype)
@@ -825,9 +835,9 @@ def forward(
                 (l, rlen, 0, 0),
             )
             # Decode-ring rows [RR, B, R+NR]: same compressed layout, ring
-            # slot leading (see KVCache).
-            cc_ring = rk_full[l][..., :R]
-            kr_ring = rk_full[l][..., R:]
+            # slot leading (see KVCache); .astype converts fp8-stored rows.
+            cc_ring = rk_full[l][..., :R].astype(x.dtype)
+            kr_ring = rk_full[l][..., R:].astype(x.dtype)
 
             def part(cc, kr, m):
                 s = (

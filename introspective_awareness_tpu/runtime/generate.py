@@ -268,8 +268,8 @@ def generate_tokens_prefix(
     # reads and appends scale with ring capacity, so carrying Ss slots
     # through every decode step would cost ~Ss/ch x the ring traffic.
     cache = cache._replace(
-        rk=jnp.zeros((L, ch, B, cache.rk.shape[-1]), dtype),
-        rv=jnp.zeros((L, ch, B, cache.rv.shape[-1]), dtype),
+        rk=jnp.zeros((L, ch, B, cache.rk.shape[-1]), cache.rk.dtype),
+        rv=jnp.zeros((L, ch, B, cache.rv.shape[-1]), cache.rv.dtype),
         rpos=jnp.zeros((B, ch), jnp.int32),
         rvalid=jnp.zeros((B, ch), jnp.bool_),
         rlen=jnp.int32(0),

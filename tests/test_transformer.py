@@ -207,6 +207,55 @@ def test_decode_ring_merge_matches_full_forward(variant):
         )
 
 
+@pytest.mark.parametrize("variant", ["mha", "mla"])
+def test_fp8_kv_cache_decode_close(variant):
+    """kv_cache_dtype="fp8" stores the cache as float8_e4m3fn: decode logits
+    must stay close to the full-precision-cache run (e4m3 keeps ~2
+    significant digits; the tolerance here is the contract the opt-in flag
+    documents). Parametrized over MHA and MLA — the absorbed-decode path
+    has its own fp8 read-conversion sites."""
+    import dataclasses
+
+    if variant == "mha":
+        cfg = tiny_config(n_layers=4)
+    else:
+        cfg = tiny_config(
+            n_layers=4, kv_lora_rank=16, qk_nope_head_dim=8,
+            qk_rope_head_dim=8, v_head_dim=16, q_lora_rank=24,
+        )
+    params = init_params(cfg, jax.random.key(0))
+    B, S, steps = 2, 7, 4
+    ids = _ids(jax.random.key(11), B, S, cfg.vocab_size)
+    mask = jnp.ones((B, S), jnp.int32)
+    pos = make_positions(mask)
+    true_len = mask.sum(axis=1)
+
+    def run(c):
+        cache = init_cache(c, B, S, ring_len=steps)
+        assert cache.k.dtype == (
+            jnp.float8_e4m3fn if c.kv_cache_dtype == "fp8" else jnp.float32
+        )
+        out = forward(
+            params, c, ids, mask, pos, cache=cache, use_cache=True,
+            is_prefill=True,
+        )
+        cache, logits = out.cache, [np.asarray(out.logits)]
+        for t in range(steps):
+            nxt = jnp.argmax(jnp.asarray(logits[0]), axis=-1)  # SAME token path
+            out = forward(
+                params, c, nxt[:, None], jnp.ones((B, 1), jnp.int32),
+                (true_len + t)[:, None], cache=cache, use_cache=True,
+            )
+            cache = out.cache
+            logits.append(np.asarray(out.logits))
+        return np.stack(logits)
+
+    ref = run(cfg)
+    fp8 = run(dataclasses.replace(cfg, kv_cache_dtype="fp8"))
+    rel = np.max(np.abs(fp8 - ref)) / (np.max(np.abs(ref)) + 1e-9)
+    assert rel < 0.05, f"fp8 KV cache perturbed logits by {rel:.3f} (rel)"
+
+
 def test_no_recompile_across_layer_and_strength(cfg, params):
     """Layer index and strength are runtime operands: sweeping them must not
     retrace (VERDICT round-1 item 2)."""
