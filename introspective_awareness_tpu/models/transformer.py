@@ -96,6 +96,19 @@ class KVCache(NamedTuple):
     rlen: jax.Array  # int32 scalar — next ring write slot
 
 
+_F8_MAX = 448.0  # float8_e4m3fn finite max; astype past it yields NaN, not sat
+
+
+def cast_kv(x: jax.Array, dtype) -> jax.Array:
+    """Cast a K/V tensor into the cache dtype, clamping into float8_e4m3fn's
+    finite range first — LLM KV outlier channels can exceed e4m3's +-448,
+    and jnp's astype converts those to NaN (not saturation), which would
+    poison every later softmax over the slot."""
+    if dtype == jnp.float8_e4m3fn and x.dtype != dtype:
+        x = jnp.clip(x, -_F8_MAX, _F8_MAX)
+    return x.astype(dtype)
+
+
 def merge_ring(cache: KVCache, cfg: ModelConfig) -> KVCache:
     """Fold the decode ring into the main slot buffer and reset the ring.
 
@@ -748,12 +761,12 @@ def forward(
             l = xs["l"]
             rk_full = lax.dynamic_update_slice(
                 xs["rk_full"],
-                jnp.swapaxes(k, 0, 1).reshape(1, S, B, -1).astype(xs["rk_full"].dtype),
+                cast_kv(jnp.swapaxes(k, 0, 1).reshape(1, S, B, -1), xs["rk_full"].dtype),
                 (l, rlen, 0, 0),
             )
             rv_full = lax.dynamic_update_slice(
                 xs["rv_full"],
-                jnp.swapaxes(v, 0, 1).reshape(1, S, B, -1).astype(xs["rv_full"].dtype),
+                cast_kv(jnp.swapaxes(v, 0, 1).reshape(1, S, B, -1), xs["rv_full"].dtype),
                 (l, rlen, 0, 0),
             )
             RR = rk_full.shape[1]
@@ -829,8 +842,9 @@ def forward(
             l = xs["l"]
             rk_full = lax.dynamic_update_slice(
                 xs["rk_full"],
-                jnp.swapaxes(row[:, :, 0, :], 0, 1)[None].astype(
-                    xs["rk_full"].dtype
+                cast_kv(
+                    jnp.swapaxes(row[:, :, 0, :], 0, 1)[None],
+                    xs["rk_full"].dtype,
                 ),
                 (l, rlen, 0, 0),
             )
@@ -993,13 +1007,13 @@ def forward(
         if use_cache:
             # Prefill: one in-place chunk write per layer group.
             new_k = lax.dynamic_update_slice(
-                cache.k, cat("k_row").astype(cache.k.dtype), (0, 0, length, 0, 0)
+                cache.k, cast_kv(cat("k_row"), cache.k.dtype), (0, 0, length, 0, 0)
             )
             if cfg.is_mla:
                 new_v = cache.v
             else:
                 new_v = lax.dynamic_update_slice(
-                    cache.v, cat("v_row").astype(cache.v.dtype), (0, 0, length, 0, 0)
+                    cache.v, cast_kv(cat("v_row"), cache.v.dtype), (0, 0, length, 0, 0)
                 )
             new_cache = KVCache(
                 k=new_k,
