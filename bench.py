@@ -186,6 +186,12 @@ def _hbm_model(runner, cfg, batch, prompt_len, max_new) -> float:
 def main() -> None:
     import jax
 
+    from introspective_awareness_tpu.utils import enable_compilation_cache
+
+    # Warm restarts skip the ~7 config compiles (~4 min of the bench's
+    # wall-clock); cold runs are unaffected beyond cache writes.
+    enable_compilation_cache()
+
     from introspective_awareness_tpu.models.config import ModelConfig, tiny_config
     from introspective_awareness_tpu.models.quant import quantize_params
     from introspective_awareness_tpu.models.tokenizer import ByteTokenizer

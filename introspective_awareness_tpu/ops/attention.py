@@ -154,10 +154,20 @@ def flash_attention(
     T, KVH = k.shape[1], k.shape[2]
     groups = NH // KVH
 
+    # The f32 score tile [g_block*block_q, block_kv] drives the scoped-VMEM
+    # budget (~16 MB): target ~2048 merged rows per grid step. block_q can't
+    # go below 128 (the positions BlockSpec's lane constraint), so high
+    # group counts (MQA-style) split the group dim across grid steps
+    # instead — g_block is the largest divisor of groups within the row
+    # budget, and each group chunk re-fetches its KV tile.
+    g_block = min(groups, 16)
+    while groups % g_block:
+        g_block -= 1
     if block_q is None:
-        block_q = max(128, min(512, (2048 // groups) // 128 * 128))
+        block_q = max(128, min(512, (2048 // g_block) // 128 * 128))
     block_q = min(block_q, _round_up(S, 8))
     block_kv = min(block_kv, _round_up(T, 128))
+    n_gblk = groups // g_block
     s_pad = _round_up(S, block_q)
     t_pad = _round_up(T, block_kv)
     if s_pad != S:
@@ -182,11 +192,11 @@ def flash_attention(
         window = 0  # disabled
     window_arr = jnp.asarray(window, jnp.int32).reshape(1)
 
-    grid = (B, KVH, s_pad // block_q, t_pad // block_kv)
+    grid = (B, KVH * n_gblk, s_pad // block_q, t_pad // block_kv)
 
     out = pl.pallas_call(
         functools.partial(
-            _flash_kernel, scale=scale, softcap=softcap, groups=groups
+            _flash_kernel, scale=scale, softcap=softcap, groups=g_block
         ),
         grid=grid,
         in_specs=[
@@ -195,19 +205,25 @@ def flash_attention(
             pl.BlockSpec((1, 1, block_kv), lambda b, h, s, t: (b, 0, t)),  # kv_positions
             pl.BlockSpec((1, 1, block_kv), lambda b, h, s, t: (b, 0, t)),  # kv_valid
             pl.BlockSpec(
-                (1, 1, groups, block_q, D), lambda b, h, s, t: (b, h, 0, s, 0)
+                (1, 1, g_block, block_q, D),
+                lambda b, h, s, t: (b, h // n_gblk, h % n_gblk, s, 0),
             ),  # q
-            pl.BlockSpec((1, 1, block_kv, D), lambda b, h, s, t: (b, h, t, 0)),  # k
-            pl.BlockSpec((1, 1, block_kv, D), lambda b, h, s, t: (b, h, t, 0)),  # v
+            pl.BlockSpec(
+                (1, 1, block_kv, D), lambda b, h, s, t: (b, h // n_gblk, t, 0)
+            ),  # k
+            pl.BlockSpec(
+                (1, 1, block_kv, D), lambda b, h, s, t: (b, h // n_gblk, t, 0)
+            ),  # v
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, groups, block_q, D), lambda b, h, s, t: (b, h, 0, s, 0)
+            (1, 1, g_block, block_q, D),
+            lambda b, h, s, t: (b, h // n_gblk, h % n_gblk, s, 0),
         ),
         out_shape=jax.ShapeDtypeStruct((B, KVH, groups, s_pad, D), q.dtype),
         scratch_shapes=[
-            pltpu.VMEM((groups * block_q, 1), jnp.float32),  # running max
-            pltpu.VMEM((groups * block_q, 1), jnp.float32),  # running sum
-            pltpu.VMEM((groups * block_q, D), jnp.float32),  # accumulator
+            pltpu.VMEM((g_block * block_q, 1), jnp.float32),  # running max
+            pltpu.VMEM((g_block * block_q, 1), jnp.float32),  # running sum
+            pltpu.VMEM((g_block * block_q, D), jnp.float32),  # accumulator
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
