@@ -351,23 +351,22 @@ def main() -> None:
 
     # ---- largest batch the halved (fp8) cache can fit ----------------------
     # Runs LAST: an OOM here must not starve the other configs of HBM.
+    # 1.5x fits on v5e (16 GB); 2x does not (measured), so don't burn a
+    # compile attempt on it every run.
     if on_tpu:
         import gc
 
         del grader, grader_params, judge
         gc.collect()
+        big = 3 * best_bf16["batch"] // 2
         try:
             results.append(
                 _timed_config(
-                    kv_runner, cfg8, tok, 2 * best_bf16["batch"], max_new,
-                    iters, "int8+fp8kv",
+                    kv_runner, cfg8, tok, big, max_new, iters, "int8+fp8kv"
                 )
             )
         except Exception as e:  # noqa: BLE001 - memory-dependent extra point
-            log(
-                f"  [int8+fp8kv] batch={2 * best_bf16['batch']}: skipped "
-                f"({type(e).__name__})"
-            )
+            log(f"  [int8+fp8kv] batch={big}: skipped ({type(e).__name__})")
             gc.collect()
 
     # Judge-graded throughput is a different workload; the headline metric

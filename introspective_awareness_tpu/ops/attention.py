@@ -7,15 +7,17 @@ makes causal + left-padding + sliding-window all simple vector compares
 inside the kernel, identical to the semantics of the model's mask
 construction (models/transformer.py `forward`).
 
-Algorithm: grid over (batch, KV head, query block, KV chunk) with the KV
-chunk innermost ("arbitrary" = sequential). Each grid step computes the KV
-head's ``groups`` query heads as ONE [groups*block_q, D] x [D, block_kv]
-dot (g-major row merge), so K/V stream from HBM once per q-block sweep and
-the kernel body has no loops. The online-softmax state (running max, sum,
-accumulator) lives in VMEM scratch across KV steps; peak VMEM is dominated
-by the f32 scores, O(groups x block_q x block_kv), regardless of sequence
-length — block_q auto-scales with ``groups`` to stay inside the TPU's
-~16 MB scoped-vmem limit. Measured 37 TFLOP/s at 32k tokens (batch 1,
+Algorithm: grid over (batch, KV head x group chunk, query block, KV chunk)
+with the KV chunk innermost ("arbitrary" = sequential). Each grid step
+computes ``g_block`` of a KV head's query heads as ONE
+[g_block*block_q, D] x [D, block_kv] dot (g-major row merge), so K/V
+stream from HBM once per q-block sweep per group chunk and the kernel body
+has no loops. The online-softmax state (running max, sum, accumulator)
+lives in VMEM scratch across KV steps; peak VMEM is dominated by the f32
+scores, O(g_block x block_q x block_kv), regardless of sequence length —
+g_block and block_q auto-scale to a ~2048-merged-row budget inside the
+TPU's ~16 MB scoped-vmem limit (GQA shapes fit all groups in one chunk;
+MQA-style counts split). Measured 37 TFLOP/s at 32k tokens (batch 1,
 Llama-1B shape) on v5e.
 """
 
@@ -36,16 +38,17 @@ def _flash_kernel(
     m_scr, l_scr, acc_scr,
     *, scale: float, softcap: float | None, groups: int,
 ):
-    """One (batch, kv-head, q-block, kv-block) grid step.
+    """One (batch, kv-head x group-chunk, q-block, kv-block) grid step.
 
-    The ``groups`` query heads of one KV head are merged (g-major) into the
-    dot's row dimension, so each step is ONE [G*BQ, D] x [D, BK] matmul with
-    no inner loop — a per-query-head grid re-fetches each kv tile ``groups``
-    times, and an all-heads-per-step kernel needs an in-kernel loop over KV
-    heads whose dynamic ref slicing defeats Mosaic's DMA pipelining
-    (measured ~0.2% MXU at 32k tokens). KV chunks are the innermost grid
-    dimension; the online-softmax state (m, l, acc) lives in VMEM scratch,
-    persisting across the sequentially-executed kv steps of one q block.
+    ``groups`` here is the caller's g_block: that many of one KV head's
+    query heads, merged (g-major) into the dot's row dimension, so each step
+    is ONE [g_block*BQ, D] x [D, BK] matmul with no inner loop — a
+    per-query-head grid re-fetches each kv tile once per query head, and an
+    all-heads-per-step kernel needs an in-kernel loop over KV heads whose
+    dynamic ref slicing defeats Mosaic's DMA pipelining (measured ~0.2% MXU
+    at 32k tokens). KV chunks are the innermost grid dimension; the
+    online-softmax state (m, l, acc) lives in VMEM scratch, persisting
+    across the sequentially-executed kv steps of one q block.
     """
     t = pl.program_id(3)
     qp = qpos_ref[0, 0, :]  # [BQ] int32

@@ -1,0 +1,97 @@
+"""Long-context smoke: steered generation over a multi-thousand-token prompt
+on the real chip, end to end through ModelRunner.
+
+The long-context story (SURVEY.md §5.7) has three layers of evidence:
+ring-attention equivalence tests (ops/ring.py, sequence-parallel over the
+mesh), flash-kernel oracle checks up to 32k tokens, and THIS script — the
+full runtime path (flash prefill -> split KV cache -> chunked decode with
+per-prompt steering) at a context length far beyond the eval's usual ~700
+tokens. Run on the default (single real TPU) environment:
+
+    python scripts/long_context_smoke.py [--tokens 16384] [--batch 4]
+
+Prints per-phase timings and a one-line OK. Random-init weights — this
+checks shapes/memory/throughput, not text quality.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=16384)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--max-new", type=int, default=32)
+    args = ap.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from introspective_awareness_tpu.models.config import ModelConfig
+    from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+    from introspective_awareness_tpu.models.transformer import init_params
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+    from introspective_awareness_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    cfg = ModelConfig(
+        vocab_size=128256, hidden_size=2048, n_layers=16, n_heads=32,
+        n_kv_heads=8, head_dim=64, mlp_hidden=8192, rope_theta=500000.0,
+        tie_embeddings=True, attn_impl="flash", max_position=131072,
+    )
+    tok = ByteTokenizer()
+    t0 = time.perf_counter()
+    init = jax.jit(init_params, static_argnames=("cfg", "dtype"))
+    params = init(cfg, jax.random.key(0), dtype=jax.numpy.bfloat16)
+    jax.block_until_ready(params)
+    print(f"init {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    runner = ModelRunner(params, cfg, tok)
+    # ByteTokenizer: 1 char = 1 token. Build exactly the filler needed so
+    # --tokens is honored at any size (a fixed-length filler would silently
+    # cap long requests and invert short ones via a negative slice).
+    n_fill = max(args.tokens - 120, 64)
+    unit = "The researcher continues the interpretability protocol. "
+    filler = (unit * (n_fill // len(unit) + 1))[:n_fill]
+    prompts = [
+        filler + f"Trial {i + 1}: Do you detect an injected thought?"
+        for i in range(args.batch)
+    ]
+    rng = np.random.default_rng(0)
+    vecs = [rng.standard_normal(cfg.hidden_size).astype(np.float32) * 5
+            for _ in prompts]
+    starts = [len(tok.encode(p)) - 50 for p in prompts]
+
+    t0 = time.perf_counter()
+    out = runner.generate_batch_with_multi_steering(
+        prompts, layer_idx=9, steering_vectors=vecs, strength=4.0,
+        max_new_tokens=args.max_new, temperature=1.0,
+        steering_start_positions=starts, seed=0,
+    )
+    warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = runner.generate_batch_with_multi_steering(
+        prompts, layer_idx=9, steering_vectors=vecs, strength=4.0,
+        max_new_tokens=args.max_new, temperature=1.0,
+        steering_start_positions=starts, seed=1,
+    )
+    hot = time.perf_counter() - t0
+    assert len(out) == args.batch
+    n_tok = len(tok.encode(prompts[0]))
+    print(
+        f"OK: batch={args.batch} x {n_tok} prompt tokens + {args.max_new} "
+        f"generated, steered; warm {warm:.1f}s (incl compile), hot {hot:.1f}s "
+        f"({args.batch * n_tok / hot:.0f} prefill tok/s e2e)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

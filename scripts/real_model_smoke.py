@@ -29,6 +29,8 @@ import re
 import sys
 from pathlib import Path
 
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
 
 def resolve_checkpoint(model: str) -> Path:
     """Local directory as-is; otherwise snapshot-download the HF repo."""

@@ -44,6 +44,18 @@ def test_flash_matches_oracle(softcap, window):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+def test_flash_mqa_group_split():
+    """MQA-style group counts (groups > 16) split the group dim across grid
+    steps (g_block chunks) — exercises the h // n_gblk / h % n_gblk index
+    arithmetic, which no repo model config reaches (all have groups <= 8)."""
+    args = _inputs(jax.random.key(3), NH=32, KVH=1)
+    scale = 16**-0.5
+    ref = xla_attention(*args, scale=scale)
+    # block_q=None engages the auto-sizing (g_block=16, n_gblk=2 here).
+    got = flash_attention(*args, scale=scale, block_kv=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
 def test_flash_unaligned_lengths():
     # S, T not multiples of the block sizes — exercises internal padding.
     args = _inputs(jax.random.key(1), S=23, T=37, left_pad=3)
