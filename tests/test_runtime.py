@@ -157,6 +157,56 @@ def test_prefix_cache_matches_full_prefill(monkeypatch):
     assert calls["prefix"] == 2
 
 
+def test_prefix_cache_composes_with_fp8_kv(monkeypatch):
+    """Shared-prefix caching + fp8 KV cache compose. The two paths are NOT
+    guaranteed bit-identical under fp8 — the prefix path's suffix chunk
+    attends to fp8-quantized rows while a full prefill attends to in-chunk
+    full-precision K/V — but on this fixed seed/config the ~0.03 logit
+    perturbation sits far under the ~0.5 greedy margins, so token equality
+    is empirically stable. If an XLA/platform change ever flips a marginal
+    token here, relax this to engagement + shape checks rather than chasing
+    bit equality."""
+    import dataclasses
+
+    import introspective_awareness_tpu.runtime.runner as rm
+
+    cfg = dataclasses.replace(tiny_config(), kv_cache_dtype="fp8")
+    params = init_params(cfg, jax.random.key(0))
+    common = "The quick brown fox jumps over the lazy dog. " * 4
+    prompts = [common + f"Trial {i}: Do you detect it?" for i in (1, 7)]
+    tok = ByteTokenizer()
+    rng = np.random.default_rng(5)
+    vecs = [rng.standard_normal(cfg.hidden_size).astype(np.float32)
+            for _ in prompts]
+    starts = [len(tok.encode(p)) - 8 for p in prompts]
+
+    calls = {"prefix": 0}
+    orig = rm.generate_tokens_prefix
+
+    def spy(*a, **k):
+        calls["prefix"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(rm, "generate_tokens_prefix", spy)
+
+    def gen(prefix_cache):
+        r = ModelRunner(
+            params, cfg, ByteTokenizer(), model_name="tiny",
+            seq_multiple=16, batch_multiple=4, prefix_cache=prefix_cache,
+            prefix_min=32,
+        )
+        return r.generate_batch_with_multi_steering(
+            prompts, layer_idx=2, steering_vectors=vecs, strength=6.0,
+            max_new_tokens=20, temperature=0.0,
+            steering_start_positions=starts,
+        )
+
+    on = gen(True)
+    assert calls["prefix"] == 1, "prefix path did not engage"
+    assert on == gen(False)
+    assert calls["prefix"] == 1
+
+
 def test_generate_chunk_size_invariance(runner, monkeypatch):
     """Greedy generation is identical whether the decode ring merges every 3
     steps or never (single chunk) — chunking is an execution detail, not a
