@@ -49,6 +49,32 @@ def test_pipeline_logits_match_forward(setup, pp, tp, n_micro):
     )
 
 
+@pytest.mark.parametrize("variant", ["moe", "sliding"])
+def test_pipeline_arch_variants_match_forward(variant):
+    """Architecture quirks survive the stage split: MoE expert stacks shard
+    their (leading) layer dim like any other trunk parameter, and Gemma-style
+    sliding-window periodicity is computed from GLOBAL layer ids via
+    layer_offset — a stage that assumed local indices would window the wrong
+    layers."""
+    if variant == "moe":
+        cfg = tiny_config(
+            n_layers=4, n_experts=4, n_experts_per_tok=2, moe_mlp_hidden=32
+        )
+    else:
+        cfg = tiny_config(n_layers=4, sliding_window=6, sliding_window_pattern=2)
+    params = init_params(cfg, jax.random.key(2))
+    B, S = 4, 12
+    ids = jax.random.randint(jax.random.key(3), (B, S), 0, cfg.vocab_size)
+    mask = jnp.ones((B, S), jnp.int32)
+    mesh = build_mesh(MeshConfig(pp=4, dp=None))
+    ref = forward(params, cfg, ids, mask, make_positions(mask),
+                  logits_mode="all").logits
+    got = pipeline_logits(params, cfg, ids, mask, mesh, 2)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_pipeline_loss_and_grads_match(setup):
     cfg, params, ids, mask = setup
     mesh = build_mesh(MeshConfig(pp=4, dp=None))
