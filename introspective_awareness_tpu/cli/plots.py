@@ -58,10 +58,14 @@ def create_sweep_plots(
 
     # Per-concept layer x strength heatmaps
     for concept in concepts:
-        grid = np.zeros((len(layer_fractions), len(strengths)))
+        # Absent cells (partial/resumed sweeps) stay NaN: imshow leaves them
+        # blank and the annotation is skipped, instead of a fake 0.00.
+        grid = np.full((len(layer_fractions), len(strengths)), np.nan)
         for i, lf in enumerate(layer_fractions):
             for j, s in enumerate(strengths):
-                grid[i, j] = rates[concept].get((lf, s), (0.0, 0.0))[0]
+                cell = rates[concept].get((lf, s))
+                if cell is not None:
+                    grid[i, j] = cell[0]
         fig, ax = plt.subplots(figsize=(8, 6))
         im = ax.imshow(grid, cmap="RdYlGn", vmin=0, vmax=1, aspect="auto")
         ax.set_xticks(range(len(strengths)), [f"{s:g}" for s in strengths])
@@ -71,7 +75,11 @@ def create_sweep_plots(
         ax.set_title(f"Detection rate: {concept}")
         for i in range(len(layer_fractions)):
             for j in range(len(strengths)):
-                ax.text(j, i, f"{grid[i, j]:.2f}", ha="center", va="center", fontsize=9)
+                if not np.isnan(grid[i, j]):
+                    ax.text(
+                        j, i, f"{grid[i, j]:.2f}",
+                        ha="center", va="center", fontsize=9,
+                    )
         fig.colorbar(im, ax=ax)
         fig.tight_layout()
         fig.savefig(individual / f"heatmap_{concept}.png", dpi=100)
@@ -89,7 +97,12 @@ def create_sweep_plots(
         ):
             fig, ax = plt.subplots(figsize=(10, 7))
             for v in lines:
-                pts = [rates[concept].get(key_of(v, x), (0.0, 0.0)) for x in xs]
+                # Absent cells (partial/resumed sweeps) plot as NaN so the
+                # line breaks, instead of a fake measured-0.0 point.
+                pts = [
+                    rates[concept].get(key_of(v, x), (np.nan, np.nan))
+                    for x in xs
+                ]
                 ax.errorbar(
                     xs, [p[0] for p in pts], yerr=[p[1] for p in pts],
                     marker="o", capsize=5, label=line_label.format(v=v),

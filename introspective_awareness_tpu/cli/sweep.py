@@ -268,21 +268,40 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             return vectors_by_fraction[lf][concept]
 
         fused: list[dict] = []
+        pass_types: list[str] = []
         for k, (trial_type, trial_nums) in enumerate(trial_plan):
+            t_pass = time.perf_counter()
             tasks = [
                 (c, t, lf, get_layer_at_fraction(runner.n_layers, lf), strength)
                 for ci, lf, si, strength in pending
                 for c in args.concepts
                 for t in trial_nums
             ]
-            fused += run_grid_pass(
+            if not tasks:
+                # An empty pass (e.g. --n-trials 1 yields no forced trials)
+                # must not record a ~0s timing: it would masquerade as the
+                # compile-carrying first pass and skew the warm-rate fields.
+                continue
+            out = run_grid_pass(
                 runner, trial_type, tasks, vector_lookup,
                 max_new_tokens=args.max_tokens, temperature=args.temperature,
                 batch_size=args.batch_size, seed=args.seed + k * 1_000_003,
             )
+            fused += out
+            # Pass-granular timings: the fused grid has no per-cell unit of
+            # work, so the manifest records per-pass times instead. The
+            # first pass carries XLA compile; a later pass can still compile
+            # its own executable when its prompts land in a different
+            # (batch, seq) shape bucket (forced trials prepend a prefill),
+            # so fused warm_pass_mean_s is an upper bound, not a pure warm
+            # rate like per-cell warm_cell_mean_s.
+            cell_times.append(round(time.perf_counter() - t_pass, 3))
+            cell_counts.append(len(out))
+            pass_types.append(trial_type)
         t_gen = time.perf_counter() - t0
         n_generated = len(fused)
         timings["fused_cells"] = len(pending)
+        timings["fused_pass_types"] = pass_types
 
         by_cell: dict = {}
         for r in fused:
@@ -347,15 +366,22 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 warm_n / warm_t / max(jax.device_count(), 1), 3
             )
     if cell_times:
-        # All cells share one executable, so the first cell's surplus over the
-        # rest is compile time. With a warm persistent compilation cache a
-        # process restart shows first_cell ≈ later cells.
-        timings["generation_cells_s"] = cell_times
-        timings["first_cell_s"] = cell_times[0]
+        # All cells/passes share one executable, so the first one's surplus
+        # over the rest is compile time. With a warm persistent compilation
+        # cache a process restart shows first ≈ later. Fused runs time at
+        # pass granularity (no per-cell unit exists there); per-cell runs at
+        # cell granularity.
+        unit = "pass" if timings.get("fused_cells") else "cell"
+        timings[f"generation_{unit}_times_s"] = cell_times
+        timings[f"first_{unit}_s"] = cell_times[0]
         if len(cell_times) > 1:
-            timings["warm_cell_mean_s"] = round(
+            timings[f"warm_{unit}_mean_s"] = round(
                 sum(cell_times[1:]) / (len(cell_times) - 1), 3
             )
+        if unit == "cell":
+            # Back-compat alias for manifest consumers written against the
+            # per-cell field name.
+            timings["generation_cells_s"] = cell_times
     _write_manifest(out_base, args, runner, timings)
     _write_summary(out_base, all_results, layer_fractions, strengths)
     return all_results
@@ -574,12 +600,15 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.pp and args.pp > 1:
         # The eval's generate/capture path scales over dp/tp/ep/sp only; a
         # pipe axis would silently replicate all sweep compute pp times.
+        # Fold those devices into data parallelism so they do useful work
+        # (pipeline parallelism serves the training path, parallel/pipeline.py).
         print(
-            f"WARNING: --pp {args.pp} builds a pipe axis the sweep does not "
-            "use (pipeline parallelism serves the training path, "
-            "parallel/pipeline.py); those devices will duplicate work. "
-            "Use --dp/--tp/--ep/--sp to scale the eval."
+            f"note: --pp {args.pp} folded into --dp for the eval path "
+            f"(dp {args.dp} -> {args.dp * args.pp}); use pipeline "
+            "parallelism via parallel/pipeline.py training APIs instead"
         )
+        args.dp *= args.pp
+        args.pp = 1
     mesh = build_mesh(
         MeshConfig(dp=args.dp, tp=args.tp, ep=args.ep, sp=args.sp, pp=args.pp)
     )

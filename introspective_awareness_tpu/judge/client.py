@@ -153,10 +153,19 @@ class OnDeviceJudgeClient:
     are plain pytrees, so XLA time-slices the chips between them.
     """
 
-    def __init__(self, runner, max_tokens: int = 500, chunk_size: int = 64):
+    # The grading prompts instruct "provide your final answer on a new line
+    # in this exact format: Answer: YES|NO" (criteria.py) — once either
+    # string is emitted the remaining token budget is pure waste, so the
+    # decode loop stops there (GenSpec.stop_seqs). parse_yes_no reads
+    # "Answer: X" wherever it appears, so truncating after it is lossless.
+    STOP_STRINGS = ("Answer: YES", "Answer: NO")
+
+    def __init__(self, runner, max_tokens: int = 500, chunk_size: int = 256):
         self.runner = runner
         self.model_name = f"on-device:{runner.model_name}"
         self.max_tokens = max_tokens
+        # Grading runs at full generation-scale batches (the subject's sweep
+        # batch is 256-384 rows); the chunk bound only caps one-shot memory.
         self.chunk_size = chunk_size
 
     def grade(self, prompts: Sequence[str]) -> list[str]:
@@ -172,7 +181,8 @@ class OnDeviceJudgeClient:
             try:
                 out.extend(
                     self.runner.generate_batch(
-                        rendered, max_new_tokens=self.max_tokens, temperature=0.0
+                        rendered, max_new_tokens=self.max_tokens,
+                        temperature=0.0, stop_strings=self.STOP_STRINGS,
                     )
                 )
             except Exception as e:  # noqa: BLE001 - contract: ERROR: strings

@@ -29,6 +29,8 @@ class Tokenizer(Protocol):
 
     def encode(self, text: str) -> list[int]: ...
 
+    def encode_plain(self, text: str) -> list[int]: ...
+
     def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str: ...
 
     def apply_chat_template(
@@ -52,6 +54,10 @@ class ByteTokenizer:
     def encode(self, text: str) -> list[int]:
         ids = list(text.encode("utf-8"))
         return ([self.BOS] + ids) if self.add_bos else ids
+
+    def encode_plain(self, text: str) -> list[int]:
+        """No special tokens — for stop-sequence matching mid-generation."""
+        return list(text.encode("utf-8"))
 
     def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
         raw = bytes(int(i) for i in ids if int(i) < 256)
@@ -94,6 +100,10 @@ class HFTokenizer:
 
     def encode(self, text: str) -> list[int]:
         return self._tok(text, add_special_tokens=True)["input_ids"]
+
+    def encode_plain(self, text: str) -> list[int]:
+        """No special tokens — for stop-sequence matching mid-generation."""
+        return self._tok(text, add_special_tokens=False)["input_ids"]
 
     def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)

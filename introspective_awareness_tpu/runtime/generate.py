@@ -18,7 +18,7 @@ reuses a single executable per (batch, seq, max_tokens) shape bucket.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +52,12 @@ class GenSpec(NamedTuple):
     steer_start: jax.Array  # [B] int32, PADDED coords; 0 = steer whole prompt
     eos_ids: jax.Array  # [n_eos] int32
     pad_id: jax.Array  # int32 scalar
+    # Optional [n_stop, Ls] int32 stop-token sequences, LEFT-padded with -1
+    # (wildcard). A row finishes (same as EOS) once its last Ls generated
+    # tokens match any sequence — e.g. the on-device judge stops at
+    # "Answer: YES|NO" instead of generating its full budget. None disables
+    # matching (and is the common executable: n_stop is a static shape).
+    stop_seqs: Optional[jax.Array] = None
 
 
 def _chunk_plan(max_new_tokens: int) -> tuple[int, int]:
@@ -111,9 +117,28 @@ def _sample_and_decode(
         temp = jnp.maximum(spec.temperature, 0.0)
         return jnp.argmax(logits + temp * g, axis=-1).astype(jnp.int32)
 
+    # Stop-sequence state: a rolling [B, Ls] tail of the last Ls generated
+    # tokens, matched each step against every stop sequence (-1 = wildcard).
+    # The initial -2 fill can never equal a real token id, so no sequence
+    # can match before enough tokens exist. Static shape: stop_seqs=None
+    # (the sweep) and stop_seqs=[n,Ls] compile to different executables.
+    stop = spec.stop_seqs
+    use_stop = stop is not None and stop.shape[0] > 0
+
+    def stop_hit(tail):
+        return jnp.any(
+            jnp.all((stop[None] < 0) | (tail[:, None, :] == stop[None]), axis=-1),
+            axis=-1,
+        )
+
     key, sub = jax.random.split(spec.rng)
     tok0 = sample(logits0, sub)
     done0 = jnp.isin(tok0, spec.eos_ids)
+    if use_stop:
+        tail0 = jnp.full((B, stop.shape[1]), -2, jnp.int32).at[:, -1].set(tok0)
+        done0 = done0 | stop_hit(tail0)
+    else:
+        tail0 = jnp.zeros((B, 0), jnp.int32)
 
     # Early-exit decode: the outer (per-chunk) while_loop stops as soon as
     # every row has hit EOS (the reference's model.generate stops the same
@@ -123,7 +148,7 @@ def _sample_and_decode(
     tokens0 = tokens0.at[:, 0].set(tok0)
 
     def step(carry, t):
-        cache, prev, done, key, tokens = carry
+        cache, prev, done, key, tokens, tail = carry
         key, sub = jax.random.split(key)
         step_pos = (true_len + t - 1)[:, None]
         out = forward(
@@ -133,28 +158,31 @@ def _sample_and_decode(
         nxt = sample(out.logits, sub)
         nxt = jnp.where(done, spec.pad_id, nxt)
         done = done | jnp.isin(nxt, spec.eos_ids)
+        if use_stop:
+            tail = jnp.concatenate([tail[:, 1:], nxt[:, None]], axis=1)
+            done = done | stop_hit(tail)
         tokens = lax.dynamic_update_slice(tokens, nxt[:, None], (0, t))
-        return out.cache, nxt, done, key, tokens
+        return out.cache, nxt, done, key, tokens, tail
 
     def chunk_cond(carry):
-        cc, _cache, _prev, done, _key, _tokens = carry
+        cc, _cache, _prev, done, _key, _tokens, _tail = carry
         return (cc < n_chunks) & ~jnp.all(done)
 
     def chunk_body(carry):
-        cc, cache, prev, done, key, tokens = carry
+        cc, cache, prev, done, key, tokens, tail = carry
 
         def inner(i, c):
-            cache, prev, done, key, tokens = c
-            return step((cache, prev, done, key, tokens), cc * ch + i + 1)
+            cache, prev, done, key, tokens, tail = c
+            return step((cache, prev, done, key, tokens, tail), cc * ch + i + 1)
 
-        cache, prev, done, key, tokens = lax.fori_loop(
-            0, ch, inner, (cache, prev, done, key, tokens)
+        cache, prev, done, key, tokens, tail = lax.fori_loop(
+            0, ch, inner, (cache, prev, done, key, tokens, tail)
         )
-        return cc + 1, merge_ring(cache, cfg), prev, done, key, tokens
+        return cc + 1, merge_ring(cache, cfg), prev, done, key, tokens, tail
 
     if max_new_tokens > 1:
-        carry = (jnp.int32(0), cache, tok0, done0, key, tokens0)
-        _, _, _, _, _, tokens = lax.while_loop(chunk_cond, chunk_body, carry)
+        carry = (jnp.int32(0), cache, tok0, done0, key, tokens0, tail0)
+        _, _, _, _, _, tokens, _ = lax.while_loop(chunk_cond, chunk_body, carry)
     else:
         tokens = tokens0
     return tokens[:, :max_new_tokens]

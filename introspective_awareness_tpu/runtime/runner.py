@@ -147,6 +147,29 @@ class ModelRunner:
             return 0
         return L0
 
+    def _stop_token_seqs(self, stop_strings: Sequence[str]):
+        """Stop strings → [n_stop, Ls] int32 (-1 left-pad = wildcard).
+
+        BPE merges leading whitespace into the first word's token, so each
+        string is encoded with plain / space / newline prefixes and every
+        distinct tokenization becomes its own candidate sequence. A match
+        that never fires costs nothing but the skipped early exit — stop
+        sequences only ever shorten generation, never change emitted text.
+        """
+        variants: list[list[int]] = []
+        for s in stop_strings:
+            for text in (s, " " + s, "\n" + s, "\n\n" + s):
+                ids = list(self.tokenizer.encode_plain(text))
+                if ids and ids not in variants:
+                    variants.append(ids)
+        if not variants:
+            return None
+        Ls = max(len(v) for v in variants)
+        arr = np.full((len(variants), Ls), -1, np.int32)
+        for i, v in enumerate(variants):
+            arr[i, Ls - len(v):] = v
+        return jnp.asarray(arr)
+
     def _decode_row(self, row: np.ndarray) -> str:
         out = []
         eos = set(int(e) for e in self.tokenizer.eos_ids)
@@ -218,6 +241,7 @@ class ModelRunner:
         steering_start_positions: Optional[Sequence[Optional[int]]] = None,
         seed: Optional[int] = None,
         debug: bool = False,
+        stop_strings: Optional[Sequence[str]] = None,
     ) -> list[str]:
         if not prompts:
             return []
@@ -293,6 +317,9 @@ class ModelRunner:
             steer_start=self._shard_batch(jnp.asarray(starts)),
             eos_ids=jnp.asarray(list(self.tokenizer.eos_ids), jnp.int32),
             pad_id=jnp.int32(self.tokenizer.pad_id),
+            stop_seqs=(
+                self._stop_token_seqs(stop_strings) if stop_strings else None
+            ),
         )
         if L0:
             tokens = generate_tokens_prefix(
@@ -327,11 +354,12 @@ class ModelRunner:
 
     def generate_batch(
         self, prompts: Sequence[str], max_new_tokens: int = 512,
-        temperature: float = 0.0, seed: Optional[int] = None, **kw,
+        temperature: float = 0.0, seed: Optional[int] = None,
+        stop_strings: Optional[Sequence[str]] = None, **kw,
     ) -> list[str]:
         return self._generate(
             list(prompts), max_new_tokens=max_new_tokens, temperature=temperature,
-            seed=seed,
+            seed=seed, stop_strings=stop_strings,
         )
 
     def generate_with_steering(

@@ -158,6 +158,35 @@ def test_fused_grid_matches_per_cell(tmp_path, monkeypatch):
         (tmp_path / "fused" / "out" / "tiny" / "run_manifest.json").read_text()
     )
     assert man["timings"]["fused_cells"] == 4
+    # Fused runs record pass-granular timings so fused and per-cell
+    # manifests stay comparable (first pass carries compile, like
+    # first_cell_s in per-cell mode).
+    assert man["timings"]["fused_pass_types"] == [
+        "injection", "control", "forced_injection"
+    ]
+    assert len(man["timings"]["generation_pass_times_s"]) == 3
+    assert man["timings"]["first_pass_s"] == man["timings"]["generation_pass_times_s"][0]
+    assert "warm_pass_mean_s" in man["timings"]
+    assert "evals_per_sec_per_chip" in man["timings"]
+
+
+def test_pp_folds_into_dp_on_eval_path(tmp_path, capsys):
+    """--pp on the eval path folds into --dp instead of silently replicating
+    sweep work across the pipe axis (pipeline parallelism serves the
+    training path, parallel/pipeline.py)."""
+    assert _run(
+        tmp_path,
+        extra=["--dp", "1", "--tp", "2", "--pp", "4",
+               "--layer-sweep", "0.5", "--strength-sweep", "4.0"],
+    ) == 0
+    out = capsys.readouterr().out
+    assert "folded into --dp" in out
+    man = json.loads(
+        (tmp_path / "out" / "tiny" / "run_manifest.json").read_text()
+    )
+    assert man["mesh"] == {
+        "pipe": 1, "data": 4, "expert": 1, "seq": 1, "model": 2
+    }
 
 
 def test_single_cell_and_overwrite(tmp_path):
