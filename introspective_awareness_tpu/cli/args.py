@@ -84,6 +84,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--pp", type=int, default=1,
                         help="Pipeline-parallel axis size (training/stage "
                              "pipelining; the eval itself scales via dp/tp)")
+    parser.add_argument("--n-devices", type=int, default=None,
+                        help="Use only the first N visible devices (default: "
+                             "all) — e.g. pin a sweep to a sub-mesh while "
+                             "another job holds the rest")
     parser.add_argument("--judge-backend", type=str, default="openai",
                         choices=["openai", "on-device", "none"],
                         help="openai = API judge (reference behavior); "
@@ -91,9 +95,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--judge-model", type=str, default="gpt-4.1-nano",
                         help="Judge model: API name, checkpoint dir, or tiny[:seed]")
     parser.add_argument("--attn-impl", type=str, default="xla",
-                        choices=["xla", "flash"],
-                        help="Attention for prefill/extraction: fused einsum "
-                             "(xla) or the Pallas flash kernel")
+                        choices=["xla", "flash", "flash_cached"],
+                        help="Attention implementation: fused einsum (xla), "
+                             "the Pallas flash kernel for prefill/extraction "
+                             "(flash — einsum decode stays the fastest path "
+                             "on v5e), or flash plus the experimental fused "
+                             "cached-attention decode kernel (flash_cached)")
     parser.add_argument("--kv-cache-dtype", type=str, default="model",
                         choices=["model", "fp8"],
                         help="KV cache storage dtype: the model dtype, or "

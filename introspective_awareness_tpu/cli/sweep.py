@@ -362,8 +362,14 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             warm_t, warm_n = t_gen, n_generated
         timings["n_evals_generated"] = n_generated
         if warm_n and warm_t > 0:
+            # Per-chip means per chip ACTUALLY USED — the mesh may be a
+            # sub-mesh of the host (--n-devices).
+            n_chips = (
+                int(runner.mesh.devices.size) if runner.mesh is not None
+                else jax.device_count()
+            )
             timings["evals_per_sec_per_chip"] = round(
-                warm_n / warm_t / max(jax.device_count(), 1), 3
+                warm_n / warm_t / max(n_chips, 1), 3
             )
     if cell_times:
         # All cells/passes share one executable, so the first one's surplus
@@ -476,7 +482,9 @@ def _write_manifest(out_base: Path, args, runner, timings: dict) -> None:
         "model": runner.model_name,
         "n_layers": runner.n_layers,
         "backend": jax.default_backend(),
-        "n_devices": jax.device_count(),
+        "n_devices": (
+            int(mesh.devices.size) if mesh is not None else jax.device_count()
+        ),
         "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)) if mesh else None,
         "dtype": args.dtype,
         "batch_size": args.batch_size,
@@ -609,8 +617,14 @@ def main(argv: Optional[list[str]] = None) -> int:
         )
         args.dp *= args.pp
         args.pp = 1
+    import jax
+
+    devices = (
+        jax.devices()[:args.n_devices] if args.n_devices else None
+    )
     mesh = build_mesh(
-        MeshConfig(dp=args.dp, tp=args.tp, ep=args.ep, sp=args.sp, pp=args.pp)
+        MeshConfig(dp=args.dp, tp=args.tp, ep=args.ep, sp=args.sp, pp=args.pp),
+        devices=devices,
     )
     rules = ShardingRules()
     judge = _build_judge(args, mesh, rules)

@@ -1,0 +1,296 @@
+"""Golden tests of the "Trial N" steering-start locator against the REAL
+chat templates of the three main subject families (VERDICT r4 #2).
+
+The committed jinja strings are the actual (public) chat templates of
+Llama-3-Instruct, Qwen2.5-Instruct (non-tool branch), and Gemma-2-it. They
+render through transformers' own template engine via ``HFTokenizer``, over a
+REAL byte-level-BPE tokenizer trained in-process on the protocol text with
+each family's special tokens — so BPE merge mechanics (the documented risk of
+the tokenize-prefix locator, reference steering_utils.py:270-287; SURVEY §2.1
+#16) are exercised for real: merges can form inside words and across spaces,
+and the tests prove none can cross the template boundary into "Trial".
+
+What would fail here if a template's tokenization shifted the steering start:
+- the pinned token counts / start indices (exact-value goldens),
+- the tightness property (token at ``start+1`` begins the "Trial" text),
+- the prefix-additivity property (len(enc(prefix)) + len(enc(rest)) ==
+  len(enc(full)) at the Trial split — the locator's core assumption).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from introspective_awareness_tpu.models.tokenizer import HFTokenizer
+from introspective_awareness_tpu.protocol.prompts import (
+    FORCED_NOTICING_PREFILL,
+    build_trial_messages,
+    render_trial_prompt,
+)
+
+# --- The real chat templates (verbatim from the released checkpoints) -------
+
+LLAMA3_TEMPLATE = (
+    "{% set loop_messages = messages %}"
+    "{% for message in loop_messages %}"
+    "{% set content = '<|start_header_id|>' + message['role'] + '<|end_header_id|>\n\n'+ message['content'] | trim + '<|eot_id|>' %}"
+    "{% if loop.index0 == 0 %}{% set content = bos_token + content %}{% endif %}"
+    "{{ content }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|start_header_id|>assistant<|end_header_id|>\n\n' }}{% endif %}"
+)
+
+# Qwen2.5-Instruct, tools-absent branch (the sweep never passes tools).
+QWEN25_TEMPLATE = (
+    "{%- if messages[0]['role'] == 'system' %}"
+    "{{- '<|im_start|>system\n' + messages[0]['content'] + '<|im_end|>\n' }}"
+    "{%- else %}"
+    "{{- '<|im_start|>system\nYou are Qwen, created by Alibaba Cloud. You are a helpful assistant.<|im_end|>\n' }}"
+    "{%- endif %}"
+    "{%- for message in messages %}"
+    "{%- if (message.role == 'user') or (message.role == 'system' and not loop.first) or (message.role == 'assistant' and not message.tool_calls) %}"
+    "{{- '<|im_start|>' + message.role + '\n' + message.content + '<|im_end|>' + '\n' }}"
+    "{%- endif %}"
+    "{%- endfor %}"
+    "{%- if add_generation_prompt %}"
+    "{{- '<|im_start|>assistant\n' }}"
+    "{%- endif %}"
+)
+
+# Gemma-2-it: no system role (raises), assistant renders as "model".
+GEMMA2_TEMPLATE = (
+    "{{ bos_token }}"
+    "{% if messages[0]['role'] == 'system' %}{{ raise_exception('System role not supported') }}{% endif %}"
+    "{% for message in messages %}"
+    "{% if (message['role'] == 'user') != (loop.index0 % 2 == 0) %}"
+    "{{ raise_exception('Conversation roles must alternate user/assistant/user/assistant/...') }}"
+    "{% endif %}"
+    "{% if (message['role'] == 'assistant') %}{% set role = 'model' %}{% else %}{% set role = message['role'] %}{% endif %}"
+    "{{ '<start_of_turn>' + role + '\n' + message['content'] | trim + '<end_of_turn>\n' }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{'<start_of_turn>model\n'}}{% endif %}"
+)
+
+FAMILIES = {
+    # name used for filter_messages_for_model; gemma must hit the no-system set
+    "llama3": dict(
+        template=LLAMA3_TEMPLATE,
+        specials=["<|begin_of_text|>", "<|start_header_id|>",
+                  "<|end_header_id|>", "<|eot_id|>"],
+        bos="<|begin_of_text|>", eos="<|eot_id|>", model_name="llama_8b",
+        gen_tail="<|start_header_id|>assistant<|end_header_id|>\n\n",
+        # char immediately before "Trial N" in the rendered string
+        pre_trial="<|end_header_id|>\n\n",
+    ),
+    "qwen25": dict(
+        template=QWEN25_TEMPLATE,
+        specials=["<|im_start|>", "<|im_end|>", "<|endoftext|>"],
+        bos=None, eos="<|im_end|>", model_name="qwen_7b",
+        gen_tail="<|im_start|>assistant\n",
+        pre_trial="<|im_start|>user\n",
+    ),
+    "gemma2": dict(
+        template=GEMMA2_TEMPLATE,
+        specials=["<bos>", "<eos>", "<start_of_turn>", "<end_of_turn>", "<pad>"],
+        bos="<bos>", eos="<eos>", model_name="gemma2_9b",
+        gen_tail="<start_of_turn>model\n",
+        pre_trial="<start_of_turn>user\n",
+    ),
+}
+
+# Exact-value goldens for trial 7 with the committed fixture corpus: any
+# template or tokenizer-mechanics change that shifts the steering start
+# breaks these on purpose.
+GOLDEN_TRIAL7 = {
+    "llama3": dict(start=118, n_tokens=150),
+    "qwen25": dict(start=116, n_tokens=148),
+    "gemma2": dict(start=112, n_tokens=146),
+}
+
+
+def _build_fixture(path: str, fam: dict) -> None:
+    """A real byte-level BPE trained on the protocol text + the family's
+    special tokens and chat template, saved in HF format."""
+    from tokenizers import Regex, Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    from introspective_awareness_tpu.protocol.prompts import (
+        INTROSPECTION_PREAMBLE,
+    )
+
+    os.makedirs(path, exist_ok=True)
+    tok = Tokenizer(models.BPE(unk_token=None))
+    # The REAL Llama-3/Qwen2 pre-tokenization: the GPT-4-style split regex
+    # (note `\s*[\r\n]+`: consecutive newlines group into ONE pre-token even
+    # when followed by text — this is why real checkpoints tokenize the
+    # "<|end_header_id|>\n\n" boundary as a single ĊĊ token) followed by
+    # byte-level mapping without its own regex. Gemma-2's sentencepiece
+    # differs in byte mapping but shares the newline-isolation property at
+    # the template boundary.
+    split = (
+        r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}"
+        r"| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+"
+    )
+    tok.pre_tokenizer = pre_tokenizers.Sequence([
+        pre_tokenizers.Split(Regex(split), behavior="isolated"),
+        pre_tokenizers.ByteLevel(add_prefix_space=False, use_regex=False),
+    ])
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=600, special_tokens=list(fam["specials"]),
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    corpus = [
+        INTROSPECTION_PREAMBLE,
+        "Trial 1: Do you detect an injected thought? Ok.",
+        "Trial 23: injected thoughts about",
+        "assistant user system model\n\n",
+        # Teach the "ĊĊ" (\n\n) merge the real Llama-3 vocab has — the
+        # double newline after <|end_header_id|> must be ONE token for the
+        # fixture to reproduce the real boundary.
+        "\n\n" * 64,
+    ]
+    tok.train_from_iterator(corpus, trainer)
+    tok.save(os.path.join(path, "tokenizer.json"))
+    cfg = {
+        "chat_template": fam["template"],
+        "eos_token": fam["eos"],
+        "model_input_names": ["input_ids", "attention_mask"],
+        "tokenizer_class": "PreTrainedTokenizerFast",
+    }
+    if fam["bos"]:
+        cfg["bos_token"] = fam["bos"]
+    with open(os.path.join(path, "tokenizer_config.json"), "w") as f:
+        json.dump(cfg, f)
+
+
+@pytest.fixture(scope="module")
+def toks(tmp_path_factory):
+    base = tmp_path_factory.mktemp("chat_templates")
+    out = {}
+    for name, fam in FAMILIES.items():
+        p = str(base / name)
+        _build_fixture(p, fam)
+        out[name] = HFTokenizer(p)
+    return out
+
+
+@pytest.mark.parametrize("name", list(FAMILIES))
+def test_rendered_structure(toks, name):
+    """The template renders the 4-turn protocol with the family's real turn
+    markers, one "Trial N" occurrence, and the generation prompt tail."""
+    fam = FAMILIES[name]
+    rendered, start = render_trial_prompt(toks[name], fam["model_name"], 7, "injection")
+    assert rendered.endswith(fam["gen_tail"])
+    assert rendered.count("Trial 7") == 1
+    assert fam["pre_trial"] + "Trial 7" in rendered
+    if name == "gemma2":
+        # system turn must be stripped (the real template raises on it) and
+        # assistant renders as "model"
+        assert "system" not in rendered
+        assert "<start_of_turn>model\nOk.<end_of_turn>" in rendered
+    if name == "qwen25":
+        # empty-system protocol message takes the template's system branch
+        assert rendered.startswith("<|im_start|>system\n")
+    if name == "llama3":
+        assert rendered.startswith(
+            "<|begin_of_text|><|start_header_id|>system<|end_header_id|>"
+        )
+    assert start is not None and start > 0
+
+
+@pytest.mark.parametrize("name", list(FAMILIES))
+def test_steering_start_pinned(toks, name):
+    """Exact golden values: fails if template or BPE mechanics shift the
+    steering start."""
+    fam = FAMILIES[name]
+    rendered, start = render_trial_prompt(toks[name], fam["model_name"], 7, "injection")
+    ids = toks[name].encode(rendered)
+    g = GOLDEN_TRIAL7[name]
+    assert start == g["start"], (start, g)
+    assert len(ids) == g["n_tokens"], (len(ids), g)
+
+
+@pytest.mark.parametrize("name", list(FAMILIES))
+@pytest.mark.parametrize("trial", [1, 7, 23, 30])
+def test_steering_start_tightness(toks, name, trial):
+    """``start`` is exactly one token before the Trial text: steering from
+    ``start`` covers "Trial {n}", and the token at ``start+1`` begins it."""
+    fam = FAMILIES[name]
+    tok = toks[name]
+    rendered, start = render_trial_prompt(tok, fam["model_name"], trial, "injection")
+    ids = tok.encode(rendered)
+    assert 0 < start < len(ids)
+    tail = tok.decode(ids[start:], skip_special_tokens=False)
+    assert f"Trial {trial}" in tail
+    after = tok.decode(ids[start + 1:], skip_special_tokens=False)
+    # The locator is one-token-early by construction; the very next token
+    # must start the Trial text (no merge swallowed it).
+    assert after.lstrip().startswith(f"Trial {trial}")
+    # ... and two tokens later the full trial label is no longer intact.
+    assert not tok.decode(ids[start + 2:], skip_special_tokens=False).startswith(
+        f"Trial {trial}"
+    )
+
+
+@pytest.mark.parametrize("name", list(FAMILIES))
+def test_prefix_additivity_at_trial_boundary(toks, name):
+    """The locator's core assumption: token counts are additive at the Trial
+    split point — no BPE merge crosses the boundary. With the byte-level
+    pre-tokenizer, "Trial" always starts a fresh pre-token after the
+    template's newline, so this holds for any trained merge set."""
+    fam = FAMILIES[name]
+    tok = toks[name]
+    rendered, _ = render_trial_prompt(tok, fam["model_name"], 23, "injection")
+    pos = rendered.find("Trial 23")
+    n_full = len(tok.encode(rendered))
+    n_prefix = len(tok.encode(rendered[:pos]))
+    n_rest = len(tok.encode_plain(rendered[pos:]))
+    assert n_prefix + n_rest == n_full
+
+
+@pytest.mark.parametrize("name", list(FAMILIES))
+def test_forced_prefill_rendering(toks, name):
+    """forced_injection: template rendered WITHOUT the generation prompt,
+    with the raw prefill string appended (reference
+    detect_injected_thoughts.py:2004-2009) — and the locator still lands one
+    token before the Trial text."""
+    fam = FAMILIES[name]
+    tok = toks[name]
+    rendered, start = render_trial_prompt(tok, fam["model_name"], 5, "forced_injection")
+    assert rendered.endswith(FORCED_NOTICING_PREFILL)
+    assert not rendered.endswith(fam["gen_tail"] + FORCED_NOTICING_PREFILL)
+    ids = tok.encode(rendered)
+    assert tok.decode(ids[start + 1:], skip_special_tokens=False).lstrip().startswith(
+        "Trial 5"
+    )
+
+
+def test_llama3_eot_in_eos_ids(toks):
+    """HFTokenizer must pick up <|eot_id|> as an EOS (Llama-3 chat turns end
+    with it, not the base eos) — decode-loop stop coverage for real
+    checkpoints."""
+    tok = toks["llama3"]
+    vocab = tok._tok.get_vocab()
+    assert vocab["<|eot_id|>"] in tok.eos_ids
+
+
+def test_gemma_system_raise_matches_filter():
+    """The real Gemma template raises on system turns — proving
+    filter_messages_for_model's strip is load-bearing, not defensive."""
+    import jinja2
+
+    msgs = build_trial_messages(1, "injection")
+    env = jinja2.Environment()
+
+    def raise_exception(msg):
+        raise jinja2.TemplateError(msg)
+
+    tpl = env.from_string(GEMMA2_TEMPLATE)
+    with pytest.raises(jinja2.TemplateError):
+        tpl.render(
+            messages=msgs, bos_token="<bos>", add_generation_prompt=True,
+            raise_exception=raise_exception,
+        )
