@@ -271,7 +271,9 @@ def main() -> None:
         import dataclasses
 
         best_bf16 = max(results, key=lambda r: r["evals_per_sec_chip"])
-        q_params = quantize_params(params, bits=8, dtype=dtype)
+        # include_embed: the tied LM head is the single largest weight read
+        # of a decode step (0.5 GB bf16 at Llama-3 vocab).
+        q_params = quantize_params(params, bits=8, dtype=dtype, include_embed=True)
         q_runner = ModelRunner(
             q_params, cfg, tok, model_name="bench-llama1b-int8"
         )

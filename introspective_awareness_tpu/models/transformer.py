@@ -69,19 +69,31 @@ def no_steer(batch: int, seq: int, hidden: int, dtype=jnp.float32) -> SteerSpec:
 
 
 class KVCache(NamedTuple):
-    """Left-pad-aware batched KV cache, split into a prefill part and a
-    decode ring.
+    """Left-pad-aware batched KV cache in three tiers: frozen prefill slots,
+    a merged decode buffer, and a small append chunk ring.
 
-    The prefill slots (``k``/``v``) are written once at prefill and FROZEN
-    during decode, so XLA lays them out for reads alone. Decode steps append
-    to the small ring (``rk``/``rv``), whose [L, R, B, heads*dim] shape makes
-    each append a dense tile-aligned write. A single mutable [L, B, T]
-    buffer forces one layout to serve per-step single-slot writes AND
-    full-cache reads — measured at ~6.7 ms/step of pure read-modify-write
-    traffic at batch 128 on v5e before the split.
+    Why three tiers (all v5e-measured at batch 256-384):
+    - A single mutable [L, B, T] buffer forces one layout to serve per-step
+      single-slot writes AND full-cache reads — ~6.7 ms/step of pure
+      read-modify-write traffic.
+    - Appending per step into a whole-generation ring is just as bad: XLA
+      lays the ring out slot-minor for the attention reads, so each append
+      read-modify-writes the layer's whole ring slab (~4-5 ms/step at 100
+      new tokens).
+    - Merging chunks back into the PREFILL buffer (the r04 design) pays a
+      full-main-buffer rewrite per merge (~12 ms at 550 slots).
 
-    Validity lives in ``slot_mask``/``rlen`` and RoPE/window positions in
-    ``positions``/``rpos``, so left-padded prompts need no re-packing.
+    So: per-step appends touch only the chunk ring ``rk``/``rv`` (slot-
+    leading [L, R, B, KVH, D]: one contiguous [B, KVH, D] slab per layer,
+    and the per-layer slice is already the attention operand). Every
+    ring-capacity steps the chunk is folded into the merged decode buffer
+    ``mk``/``mv`` (``merge_chunk``) whose RMW slab is bounded by the decode
+    length, never the prompt. The prefill slots stay frozen; attention runs
+    over (main ⊕ merged ⊕ chunk) under one softmax.
+
+    Validity lives in ``slot_mask``/``mvalid``/``rlen`` and RoPE/window
+    positions in ``positions``/``mpos``/``rpos``, so left-padded prompts
+    need no re-packing.
     """
 
     k: jax.Array  # [L, B, T0, KVH, KD] — prefill slots, frozen in decode
@@ -89,11 +101,16 @@ class KVCache(NamedTuple):
     slot_mask: jax.Array  # [B, T0] bool — valid prefill slots
     positions: jax.Array  # [B, T0] int32 — rope position of each slot
     length: jax.Array  # int32 scalar — next prefill write slot
-    rk: jax.Array  # [L, R, B, KVH*KD] — decode ring (append-only)
-    rv: jax.Array  # [L, R, B, KVH*VD]
+    rk: jax.Array  # [L, R, B, KVH, KD] — chunk ring (append-only)
+    rv: jax.Array  # [L, R, B, KVH, VD]
     rpos: jax.Array  # [B, R] int32 — rope positions of ring slots
     rvalid: jax.Array  # [B, R] bool — real-token ring slots (pads False)
     rlen: jax.Array  # int32 scalar — next ring write slot
+    mk: jax.Array  # [L, RM, B, KVH, KD] — merged decode slots (RM may be 0)
+    mv: jax.Array  # [L, RM, B, KVH, VD]
+    mpos: jax.Array  # [B, RM] int32
+    mvalid: jax.Array  # [B, RM] bool
+    mlen: jax.Array  # int32 scalar — next merged write slot
 
 
 _F8_MAX = 448.0  # float8_e4m3fn finite max; astype past it yields NaN, not sat
@@ -118,15 +135,14 @@ def merge_ring(cache: KVCache, cfg: ModelConfig) -> KVCache:
     ring. Slots past ``rlen`` in the appended chunk carry stale data and are
     left invalid in ``slot_mask``; the next merge overwrites them (``length``
     advances by ``rlen``, not ring capacity)."""
-    L, RR, B, _ = cache.rk.shape
-    kvh, kd = cfg.cache_kv_heads, cfg.cache_k_dim
+    L, RR, B = cache.rk.shape[:3]
     vd = cache.v.shape[-1]
-    k_rows = cache.rk.reshape(L, RR, B, kvh, kd).transpose(0, 2, 1, 3, 4)
+    k_rows = jnp.swapaxes(cache.rk, 1, 2)  # [L, B, RR, KVH, KD]
     new_k = lax.dynamic_update_slice(
         cache.k, k_rows.astype(cache.k.dtype), (0, 0, cache.length, 0, 0)
     )
     if vd:
-        v_rows = cache.rv.reshape(L, RR, B, kvh, vd).transpose(0, 2, 1, 3, 4)
+        v_rows = jnp.swapaxes(cache.rv, 1, 2)
         new_v = lax.dynamic_update_slice(
             cache.v, v_rows.astype(cache.v.dtype), (0, 0, cache.length, 0, 0)
         )
@@ -141,24 +157,59 @@ def merge_ring(cache: KVCache, cfg: ModelConfig) -> KVCache:
     new_positions = lax.dynamic_update_slice(
         cache.positions, cache.rpos, (0, cache.length)
     )
-    return KVCache(
+    return cache._replace(
         k=new_k, v=new_v, slot_mask=new_slot_mask, positions=new_positions,
         length=cache.length + cache.rlen,
-        rk=cache.rk, rv=cache.rv, rpos=cache.rpos, rvalid=cache.rvalid,
+        rlen=jnp.int32(0),
+    )
+
+
+def merge_chunk(cache: KVCache, cfg: ModelConfig) -> KVCache:
+    """Fold the chunk ring into the MERGED decode buffer and reset the ring.
+
+    The decode-loop counterpart of ``merge_ring``: called every ring-capacity
+    decode steps, its read-modify-write slab is the merged buffer (bounded by
+    the decode length), not the prompt-sized prefill buffer."""
+    L, RR, B = cache.rk.shape[:3]
+    vd = cache.v.shape[-1]
+    # Chunk ring and merged buffer share the slot-leading layout, so the
+    # fold is a direct contiguous multi-slab copy — no transpose, and any
+    # read-modify-write is bounded by the merged slab, amortized over the
+    # chunk.
+    new_mk = lax.dynamic_update_slice(
+        cache.mk, cache.rk.astype(cache.mk.dtype), (0, cache.mlen, 0, 0, 0)
+    )
+    if vd:
+        new_mv = lax.dynamic_update_slice(
+            cache.mv, cache.rv.astype(cache.mv.dtype),
+            (0, cache.mlen, 0, 0, 0),
+        )
+    else:
+        new_mv = cache.mv
+    valid = (
+        jnp.arange(RR, dtype=jnp.int32)[None, :] < cache.rlen
+    ) & cache.rvalid
+    return cache._replace(
+        mk=new_mk, mv=new_mv,
+        mvalid=lax.dynamic_update_slice(cache.mvalid, valid, (0, cache.mlen)),
+        mpos=lax.dynamic_update_slice(cache.mpos, cache.rpos, (0, cache.mlen)),
+        mlen=cache.mlen + cache.rlen,
         rlen=jnp.int32(0),
     )
 
 
 def init_cache(
     cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.float32,
-    ring_len: int = 0,
+    ring_len: int = 0, merged_len: int = 0,
 ) -> KVCache:
     """MHA caches per-head k/v; MLA caches one row of compressed-kv + shared
     rope key per token (``v`` is unused and kept zero-width). ``max_len``
-    sizes the prefill part; ``ring_len`` the decode ring (the number of
-    decode steps that will append). ``cfg.kv_cache_dtype="fp8"`` stores the
-    payload as float8_e4m3fn (writers .astype into the buffers; readers
-    convert back — see the attention fns)."""
+    sizes the prefill part, ``ring_len`` the append chunk ring, and
+    ``merged_len`` the merged decode buffer (0 when the caller never calls
+    ``merge_chunk``, e.g. single-chunk decodes or the suffix pass).
+    ``cfg.kv_cache_dtype="fp8"`` stores the payload as float8_e4m3fn
+    (writers .astype into the buffers; readers convert back — see the
+    attention fns)."""
     kvh, kd = cfg.cache_kv_heads, cfg.cache_k_dim
     vd = 0 if cfg.is_mla else cfg.head_dim
     L = cfg.n_layers
@@ -170,11 +221,16 @@ def init_cache(
         slot_mask=jnp.zeros((batch, max_len), jnp.bool_),
         positions=jnp.zeros((batch, max_len), jnp.int32),
         length=jnp.int32(0),
-        rk=jnp.zeros((L, ring_len, batch, kvh * kd), dtype),
-        rv=jnp.zeros((L, ring_len, batch, kvh * vd), dtype),
+        rk=jnp.zeros((L, ring_len, batch, kvh, kd), dtype),
+        rv=jnp.zeros((L, ring_len, batch, kvh, vd), dtype),
         rpos=jnp.zeros((batch, ring_len), jnp.int32),
         rvalid=jnp.zeros((batch, ring_len), jnp.bool_),
         rlen=jnp.int32(0),
+        mk=jnp.zeros((L, merged_len, batch, kvh, kd), dtype),
+        mv=jnp.zeros((L, merged_len, batch, kvh, vd), dtype),
+        mpos=jnp.zeros((batch, merged_len), jnp.int32),
+        mvalid=jnp.zeros((batch, merged_len), jnp.bool_),
+        mlen=jnp.int32(0),
     )
 
 
@@ -495,27 +551,30 @@ def _attention_decode(
     k_old: jax.Array,  # [B, T0, KVH, D] frozen prefill slots
     v_old: jax.Array,
     m_old: jax.Array,  # [B, S, T0]
-    rk: jax.Array,  # [R, B, KVH, D] decode-ring slots (incl. current chunk)
+    rk: jax.Array,  # [R, B, KVH, D] chunk-ring slots (incl. current chunk)
     rv: jax.Array,
     m_ring: jax.Array,  # [B, S, R]
     cfg: ModelConfig,
+    mk: jax.Array | None = None,  # [RM, B, KVH, D] merged decode slots
+    mv: jax.Array | None = None,
+    m_merged: jax.Array | None = None,  # [B, S, RM]
 ) -> jax.Array:
-    """Decode attention over (frozen prefill slots ⊕ decode ring) under one
-    shared softmax. The current chunk's rows are appended to the ring BEFORE
-    this runs, so the ring part covers them (m_ring is causal over the chunk
-    slots); the big prefill buffer is never written during decode, so its
-    layout serves reads alone (see KVCache)."""
+    """Decode attention over (frozen prefill slots ⊕ merged decode slots ⊕
+    chunk ring) under one shared softmax. The current chunk's rows are
+    appended to the ring BEFORE this runs, so the ring part covers them
+    (m_ring is causal over the chunk slots); the prefill and merged buffers
+    are never written inside a chunk, so their layouts serve reads alone
+    (see KVCache)."""
     B, S, NH, D = q.shape
     KVH = k_old.shape[2]
     groups = NH // KVH
     qg = q.reshape(B, S, KVH, groups, D)
     scale = cfg.query_scale if cfg.query_scale is not None else D**-0.5
+    use_merged = mk is not None and mk.shape[0] > 0
     # fp8-stored caches convert back at the dot (the convert fuses into the
     # operand read; the HBM stream stays fp8-sized).
-    k_old, v_old, rk, rv = (
-        a.astype(q.dtype) if a.dtype != q.dtype else a
-        for a in (k_old, v_old, rk, rv)
-    )
+    cast = lambda a: a.astype(q.dtype) if a.dtype != q.dtype else a
+    k_old, v_old, rk, rv = map(cast, (k_old, v_old, rk, rv))
 
     def part(eq, k, m):
         s = jnp.einsum(eq, qg, k, preferred_element_type=jnp.float32) * scale
@@ -524,18 +583,19 @@ def _attention_decode(
             s = cap * jnp.tanh(s / cap)
         return jnp.where(m[:, None, None, :, :], s, _NEG_INF)
 
-    scores = jnp.concatenate(
-        [
-            part("bskgd,btkd->bkgst", k_old, m_old),
-            part("bskgd,rbkd->bkgsr", rk, m_ring),
-        ],
-        axis=-1,
-    )
+    parts = [part("bskgd,btkd->bkgst", k_old, m_old)]
+    if use_merged:
+        mk, mv = cast(mk), cast(mv)
+        parts.append(part("bskgd,rbkd->bkgsr", mk, m_merged))
+    parts.append(part("bskgd,rbkd->bkgsr", rk, m_ring))
+    scores = jnp.concatenate(parts, axis=-1)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     T0 = k_old.shape[1]
-    out = jnp.einsum("bkgst,btkd->bskgd", probs[..., :T0], v_old) + jnp.einsum(
-        "bkgsr,rbkd->bskgd", probs[..., T0:], rv
-    )
+    TM = T0 + (mk.shape[0] if use_merged else 0)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs[..., :T0], v_old)
+    if use_merged:
+        out = out + jnp.einsum("bkgsr,rbkd->bskgd", probs[..., T0:TM], mv)
+    out = out + jnp.einsum("bkgsr,rbkd->bskgd", probs[..., TM:], rv)
     return out.reshape(B, S, NH, v_old.shape[-1])
 
 
@@ -622,7 +682,7 @@ def forward(
     read_cache = use_cache and not is_prefill  # prefill never reads old slots
     new_slot_mask = new_positions = new_rpos = new_rvalid = None
     length = rlen = None
-    allowed_old = allowed_ring = None
+    allowed_old = allowed_ring = allowed_merged = None
     if use_cache:
         assert cache is not None
         length = cache.length
@@ -661,6 +721,16 @@ def forward(
             new_rvalid = lax.dynamic_update_slice(
                 cache.rvalid, attn_mask.astype(jnp.bool_), (0, rlen)
             )
+            # Merged decode slots: all strictly earlier (written at chunk
+            # boundaries), gated by write count + per-row validity.
+            RM = cache.mk.shape[1]
+            allowed_merged = jnp.broadcast_to(
+                (
+                    (jnp.arange(RM, dtype=jnp.int32)[None, :] < cache.mlen)
+                    & cache.mvalid
+                )[:, None, :],
+                (B, S, RM),
+            )
 
     if cfg.sliding_window is not None:
         delta = positions[:, :, None] - positions[:, None, :]  # [B, S, S]
@@ -674,12 +744,18 @@ def forward(
             allowed_ring_local = (
                 allowed_ring & (delta_ring < cfg.sliding_window) & (delta_ring >= 0)
             )
+            delta_m = positions[:, :, None] - cache.mpos[:, None, :]
+            allowed_merged_local = (
+                allowed_merged & (delta_m < cfg.sliding_window) & (delta_m >= 0)
+            )
         else:
             allowed_old_local = allowed_ring_local = None
+            allowed_merged_local = None
     else:
         allowed_local = allowed
         allowed_old_local = allowed_old
         allowed_ring_local = allowed_ring
+        allowed_merged_local = allowed_merged
 
     # Per-layer flags/ids as scan xs (runtime operands, never recompile).
     # Sized from the parameter stacks (== cfg.n_layers for a full model, a
@@ -738,7 +814,8 @@ def forward(
 
         backend = jax.default_backend()
         use_flash = (
-            cfg.attn_impl == "flash" and S > 1 and (not use_cache or is_prefill)
+            cfg.attn_impl in ("flash", "flash_cached") and S > 1
+            and (not use_cache or is_prefill)
             # Mosaic lowers on TPU only; CPU runs the kernel in interpret mode
             # for tests. Any other backend (e.g. GPU) falls back to the einsum
             # path instead of failing at lowering time.
@@ -750,6 +827,56 @@ def forward(
             # write at a static layer index), then attend over frozen prefill
             # slots ⊕ ring under one softmax — the ring mask covers the
             # chunk's own slots causally, so no separate chunk part exists.
+            l = xs["l"]
+            # Slot-leading 5D ring: the append writes ONE contiguous
+            # [B, KVH, D] slab per layer, and the per-layer slice is already
+            # in the einsum's operand shape — no reshape/copy on either side
+            # (a [B,R,C]-flat ring cost ~5 ms/step in layout copies, and a
+            # batch-major ring ~1.8 ms/step in strided appends, at batch 384
+            # on v5e).
+            rk_full = lax.dynamic_update_slice(
+                xs["rk_full"],
+                cast_kv(jnp.swapaxes(k, 0, 1)[None], xs["rk_full"].dtype),
+                (l, rlen, 0, 0, 0),
+            )
+            rv_full = lax.dynamic_update_slice(
+                xs["rv_full"],
+                cast_kv(jnp.swapaxes(v, 0, 1)[None], xs["rv_full"].dtype),
+                (l, rlen, 0, 0, 0),
+            )
+            rk = rk_full[l]  # [RR, B, KVH, D]
+            rv = rv_full[l]
+            if cfg.attn_impl == "flash_cached" and backend in ("tpu", "cpu"):
+                # Fused cached attention (Pallas): streams (frozen slots ⊕
+                # ring) once, scores stay in VMEM, fp8 caches read natively.
+                # Requires the whole-generation chunk ring (runtime.generate
+                # sizes it so for flash_cached): slots at or past the append
+                # point have never been written, so position-space validity
+                # is exact; the merged tier must be empty.
+                assert cache.mk.shape[1] == 0, (
+                    "flash_cached requires merged_len=0 (whole-generation "
+                    "chunk ring)"
+                )
+                from introspective_awareness_tpu.ops.cached_attention import (
+                    cached_attention,
+                )
+
+                win = (
+                    jnp.where(sliding, cfg.sliding_window, 0)
+                    if cfg.sliding_window is not None else 0
+                )
+                attn = cached_attention(
+                    q, cache.k, cache.v, cache.positions, cache.slot_mask,
+                    jnp.swapaxes(rk, 0, 1), jnp.swapaxes(rv, 0, 1),
+                    new_rpos, new_rvalid, positions,
+                    layer=l,
+                    scale=cfg.query_scale if cfg.query_scale is not None
+                    else cfg.head_dim**-0.5,
+                    softcap=cfg.attn_logit_softcap,
+                    window=win,
+                    interpret=backend == "cpu",
+                )
+                return attn, rk_full, rv_full
             amask_old = (
                 jnp.where(sliding, allowed_old_local, allowed_old)
                 if cfg.sliding_window else allowed_old
@@ -758,22 +885,13 @@ def forward(
                 jnp.where(sliding, allowed_ring_local, allowed_ring)
                 if cfg.sliding_window else allowed_ring
             )
-            l = xs["l"]
-            rk_full = lax.dynamic_update_slice(
-                xs["rk_full"],
-                cast_kv(jnp.swapaxes(k, 0, 1).reshape(1, S, B, -1), xs["rk_full"].dtype),
-                (l, rlen, 0, 0),
+            amask_merged = (
+                jnp.where(sliding, allowed_merged_local, allowed_merged)
+                if cfg.sliding_window else allowed_merged
             )
-            rv_full = lax.dynamic_update_slice(
-                xs["rv_full"],
-                cast_kv(jnp.swapaxes(v, 0, 1).reshape(1, S, B, -1), xs["rv_full"].dtype),
-                (l, rlen, 0, 0),
-            )
-            RR = rk_full.shape[1]
-            rk = rk_full[l].reshape(RR, B, cfg.n_kv_heads, cfg.head_dim)
-            rv = rv_full[l].reshape(RR, B, cfg.n_kv_heads, cfg.head_dim)
             attn = _attention_decode(
-                q, xs["ck"], xs["cv"], amask_old, rk, rv, amask_ring, cfg
+                q, xs["ck"], xs["cv"], amask_old, rk, rv, amask_ring, cfg,
+                mk=cache.mk[l], mv=cache.mv[l], m_merged=amask_merged,
             )
             return attn, rk_full, rv_full
         elif use_flash:
@@ -842,16 +960,13 @@ def forward(
             l = xs["l"]
             rk_full = lax.dynamic_update_slice(
                 xs["rk_full"],
-                cast_kv(
-                    jnp.swapaxes(row[:, :, 0, :], 0, 1)[None],
-                    xs["rk_full"].dtype,
-                ),
-                (l, rlen, 0, 0),
+                cast_kv(jnp.swapaxes(row, 0, 1)[None], xs["rk_full"].dtype),
+                (l, rlen, 0, 0, 0),
             )
-            # Decode-ring rows [RR, B, R+NR]: same compressed layout, ring
-            # slot leading (see KVCache); .astype converts fp8-stored rows.
-            cc_ring = rk_full[l][..., :R].astype(x.dtype)
-            kr_ring = rk_full[l][..., R:].astype(x.dtype)
+            # Decode-ring rows [RR, B, 1, R+NR]: same compressed layout,
+            # slot-leading (see KVCache); .astype converts fp8-stored rows.
+            cc_ring = rk_full[l][:, :, 0, :R].astype(x.dtype)
+            kr_ring = rk_full[l][:, :, 0, R:].astype(x.dtype)
 
             def part(cc, kr, m):
                 s = (
@@ -870,14 +985,29 @@ def forward(
             ) * scale
             s_ring = jnp.where(allowed_ring[:, None, :, :], s_ring, _NEG_INF)
 
-            scores = jnp.concatenate(
-                [part(cc_old, kr_old, allowed_old), s_ring], axis=-1
-            )
+            parts = [part(cc_old, kr_old, allowed_old)]
+            use_merged = cache.mk.shape[1] > 0
+            if use_merged:
+                cc_m = cache.mk[l][:, :, 0, :R].astype(x.dtype)  # [RM, B, Rk]
+                kr_m = cache.mk[l][:, :, 0, R:].astype(x.dtype)
+                s_m = (
+                    jnp.einsum("bsnr,obr->bnso", q_abs, cc_m,
+                               preferred_element_type=jnp.float32)
+                    + jnp.einsum("bsnd,obd->bnso", q_rot, kr_m,
+                                 preferred_element_type=jnp.float32)
+                ) * scale
+                parts.append(
+                    jnp.where(allowed_merged[:, None, :, :], s_m, _NEG_INF)
+                )
+            parts.append(s_ring)
+            scores = jnp.concatenate(parts, axis=-1)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             T = cc_old.shape[1]
-            ctx = jnp.einsum(
-                "bnst,btr->bsnr", probs[..., :T], cc_old
-            ) + jnp.einsum("bnso,obr->bsnr", probs[..., T:], cc_ring)
+            TM = T + (cc_m.shape[0] if use_merged else 0)
+            ctx = jnp.einsum("bnst,btr->bsnr", probs[..., :T], cc_old)
+            if use_merged:
+                ctx = ctx + jnp.einsum("bnso,obr->bsnr", probs[..., T:TM], cc_m)
+            ctx = ctx + jnp.einsum("bnso,obr->bsnr", probs[..., TM:], cc_ring)
             attn = jnp.einsum("bsnr,rnd->bsnd", ctx, wv_b)  # [B,S,NH,VD]
             return attn, rk_full
         else:
@@ -982,9 +1112,7 @@ def forward(
                     new_rv = ys["rv_full"]
                 if capture:
                     caps.append(ys["cap"])
-        new_cache = KVCache(
-            k=cache.k, v=cache.v, slot_mask=cache.slot_mask,
-            positions=cache.positions, length=length,
+        new_cache = cache._replace(
             rk=new_rk, rv=new_rv, rpos=new_rpos, rvalid=new_rvalid,
             rlen=rlen + S,
         )
@@ -1015,17 +1143,12 @@ def forward(
                 new_v = lax.dynamic_update_slice(
                     cache.v, cast_kv(cat("v_row"), cache.v.dtype), (0, 0, length, 0, 0)
                 )
-            new_cache = KVCache(
+            new_cache = cache._replace(
                 k=new_k,
                 v=new_v,
                 slot_mask=new_slot_mask,
                 positions=new_positions,
                 length=length + S,
-                rk=cache.rk,
-                rv=cache.rv,
-                rpos=cache.rpos,
-                rvalid=cache.rvalid,
-                rlen=cache.rlen,
             )
         captured = cat("cap") if capture else None  # [L, B, H]
 
@@ -1044,7 +1167,12 @@ def embed_tokens(params: dict, cfg: ModelConfig, ids: jax.Array) -> jax.Array:
     """Token embedding (+ Gemma's sqrt(H) scale) — the model's input side,
     shared by ``forward`` and the pipeline driver (parallel/pipeline.py)."""
     dtype = params["embed"].dtype
-    h = params["embed"][ids]
+    emb = params["embed"]
+    if hasattr(emb, "q"):  # int8 embed (quant.QuantizedTensor): gather rows,
+        # dequantize per token with the per-vocab-row scale.
+        h = (emb.q[ids].astype(jnp.float32) * emb.scale[ids]).astype(dtype)
+    else:
+        h = emb[ids]
     if cfg.embed_scale:
         h = (h.astype(jnp.float32) * (cfg.hidden_size**0.5)).astype(dtype)
     return h
@@ -1055,10 +1183,18 @@ def lm_head_logits(params: dict, cfg: ModelConfig, h: jax.Array) -> jax.Array:
     [B, S, H] — the model's output side, shared by ``forward`` and the
     pipeline driver."""
     hn = rms_norm(h, params["final_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum(
-        "bsh,hv->bsv", hn, head, preferred_element_type=jnp.float32
-    )
+    if cfg.tie_embeddings:
+        # Contract against the [V, H] embedding directly — transposing a
+        # dequantized int8 head would materialize a 0.5-GB copy per step.
+        logits = jnp.einsum(
+            "bsh,vh->bsv", hn, W(params["embed"]),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        logits = jnp.einsum(
+            "bsh,hv->bsv", hn, W(params["lm_head"]),
+            preferred_element_type=jnp.float32,
+        )
     if cfg.final_logit_softcap:
         cap = cfg.final_logit_softcap
         logits = cap * jnp.tanh(logits / cap)

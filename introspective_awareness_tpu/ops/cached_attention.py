@@ -1,0 +1,324 @@
+"""Pallas cached attention for TPU: one fused kernel over (frozen prefill
+slots ⊕ decode ring) under a single online softmax.
+
+This is the decode/suffix counterpart of ``ops.attention.flash_attention``
+(which covers the no-cache chunk case), replacing the XLA einsum path of
+``models.transformer._attention_decode``. The einsum path materializes the
+f32 score tensor in HBM — at batch 384 on a 1B-shape model that is ~1.6 ms of
+softmax traffic per decode step and multi-GB score tensors on the cached
+suffix-prefill pass — and forces XLA into a slot-minor cache layout whose
+ring merges degrade to ~7 GB/s read-modify-writes. The kernel streams both
+cache parts once per step, keeps scores in VMEM, reads fp8-stored caches
+natively (the HBM stream stays fp8-sized), and lets the cache settle into the
+row-major layout that makes prefill's chunk appends contiguous.
+
+Masking is position-space, identical to ``ops.attention``: every slot carries
+its RoPE position and a validity bit; causal + left-padding + sliding-window
+are vector compares inside the kernel. The ring's "written slots plus the
+current chunk causally" visibility rule (models/transformer.py forward)
+reduces to exactly these compares because ring appends are monotone in
+position and unwritten slots stay invalid.
+
+Grid: (batch, q block, kv step) with kv innermost ("arbitrary" =
+sequential). KV steps sweep the main-cache tiles first, then the ring tiles;
+``pl.when`` selects the source, and the clamped index maps re-present the
+same block to the inactive source (Mosaic skips the DMA when a block index
+repeats). KV heads are an unrolled in-kernel loop — a [BK, KVH, D] main tile
+is one contiguous HBM slab, so all heads stream in a single DMA, and each
+head's dot merges its GQA query heads (q-major) into the row dimension.
+
+Role match: the decode half of the reference's flash-attn dependency
+(reference pyproject.toml:33) — the reference itself never fuses decode
+attention; HF's generate runs eager per-step attention there.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _cached_kernel(
+    window_ref, qpos_ref, cpos_ref, cvalid_ref, rpos_ref, rvalid_ref,
+    q_ref, ck_ref, cv_ref, rk_ref, rv_ref, o_ref,
+    m_scr, l_scr, acc_scr,
+    *, scale: float, softcap: float | None, groups: int, n_main: int,
+):
+    """One (batch, q-block, kv-step) grid step.
+
+    kv steps [0, n_main) read main-cache tiles [BK, KVH, D]; steps >= n_main
+    read ring tiles [BR, 1, KVH, D]. The mask is computed once per tile and
+    shared by the unrolled per-KV-head updates; online-softmax state is
+    per-head rows of the VMEM scratch, persisting across kv steps.
+    """
+    t = pl.program_id(2)
+    window = window_ref[0]
+    qp = qpos_ref[0, 0, :]  # [BQ]
+    kvh = ck_ref.shape[3]
+    G, BQ, D = groups, q_ref.shape[1], q_ref.shape[3]
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def update(kp, valid, get_k, get_v):
+        """Shared online-softmax update; ``get_k/get_v(h)`` yield [BK, D]."""
+        has_valid = valid != 0
+        kp_min = jnp.min(jnp.where(has_valid, kp, jnp.int32(2**30)))
+        kp_max = jnp.max(jnp.where(has_valid, kp, jnp.int32(-(2**30))))
+        tile_live = (kp_min <= jnp.max(qp)) & (
+            (window <= 0) | (kp_max > jnp.min(qp) - window)
+        )
+
+        @pl.when(tile_live)
+        def _update():
+            allowed = (kp[None, :] <= qp[:, None]) & has_valid[None, :]
+            allowed &= (window <= 0) | ((qp[:, None] - kp[None, :]) < window)
+            # q-major row merge: row i of a head's dot is query i // G,
+            # query-head-in-group i % G.
+            allowed_g = jnp.repeat(allowed, G, axis=0)  # [BQ*G, BK]
+            maskf = allowed_g.astype(jnp.float32)
+            # Dots run in the model dtype with f32 accumulation (bf16 inputs
+            # are MXU-native; f32 operands would triple the MXU passes) —
+            # the same operating point as XLA's default-precision einsum.
+            cdt = q_ref.dtype
+            for h in range(kvh):
+                qh = q_ref[0, :, h * G:(h + 1) * G, :].reshape(BQ * G, D)
+                k = get_k(h).astype(cdt)  # [BK, D]
+                s = jax.lax.dot_general(
+                    qh, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale
+                if softcap is not None:
+                    s = softcap * jnp.tanh(s / softcap)
+                s = jnp.where(allowed_g, s, _NEG_INF)
+                m = m_scr[h]
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+                # Explicit mask multiply: on an all-masked row m_new stays
+                # _NEG_INF and exp(s - m_new) = 1 everywhere; the multiply
+                # keeps l at 0 so _finish emits zeros, not garbage.
+                p = jnp.exp(s - m_new) * maskf
+                alpha = jnp.exp(m - m_new)
+                m_scr[h] = m_new
+                l_scr[h] = l_scr[h] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+                # Invalid rows must be SCRUBBED from v, not just masked in
+                # p: out-of-range block tails carry unspecified bits
+                # (possibly NaN), and both 0 * NaN and p-side masking leave
+                # NaN in the dot. jnp.where on a NaN operand is the only
+                # safe form; the condition comes from a 32-bit compare
+                # because Mosaic can't widen the minor dim of i1 vectors.
+                maskcol = has_valid.astype(jnp.float32)[:, None]
+                v = jnp.where(
+                    maskcol > 0, get_v(h).astype(jnp.float32), 0.0
+                ).astype(cdt)
+                acc_scr[h] = acc_scr[h] * alpha + jax.lax.dot_general(
+                    p.astype(cdt), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+    @pl.when(t < n_main)
+    def _main():
+        update(
+            cpos_ref[0, 0, :], cvalid_ref[0, 0, :],
+            lambda h: ck_ref[0, 0, :, h, :], lambda h: cv_ref[0, 0, :, h, :],
+        )
+
+    @pl.when(t >= n_main)
+    def _ring():
+        update(
+            rpos_ref[0, 0, :], rvalid_ref[0, 0, :],
+            lambda h: rk_ref[0, :, h, :], lambda h: rv_ref[0, :, h, :],
+        )
+
+    @pl.when(t == pl.num_programs(2) - 1)
+    def _finish():
+        for h in range(kvh):
+            o = acc_scr[h] / jnp.maximum(l_scr[h], 1e-30)
+            o_ref[0, :, h * G:(h + 1) * G, :] = o.reshape(BQ, G, D).astype(
+                o_ref.dtype
+            )
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "layer", "scale", "softcap", "block_q", "block_kv", "block_r",
+        "interpret",
+    ),
+)
+def cached_attention(
+    q: jax.Array,  # [B, S, NH, D]
+    ck: jax.Array,  # [L, B, T0, KVH, D] FULL stacked cache (any dtype incl. fp8)
+    cv: jax.Array,  # [L, B, T0, KVH, D]
+    c_pos: jax.Array,  # [B, T0] int32 rope positions of main slots
+    c_valid: jax.Array,  # [B, T0] bool/int — valid main slots
+    rk: jax.Array,  # [B, R, KVH, D] decode ring, batch-major (cache dtype)
+    rv: jax.Array,  # [B, R, KVH, D]
+    r_pos: jax.Array,  # [B, R]
+    r_valid: jax.Array,  # [B, R]
+    q_pos: jax.Array,  # [B, S]
+    *,
+    layer: int = 0,  # static layer index into the stacked cache
+    scale: float,
+    softcap: float | None = None,
+    window=None,  # int / traced int32 scalar; None or <=0 disables
+    block_q: int = 128,
+    block_kv: int = 512,
+    block_r: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused attention of a chunk against (main cache ⊕ ring). [B,S,NH,D].
+
+    The main cache rides in FULL, stacked over layers, with the static
+    ``layer`` baked into the BlockSpec index map — a sliced operand would
+    force XLA to materialize a per-layer copy of the 100-MB-class buffer
+    every decode step. The ring is small and batch-major ([B, R, ...]) so
+    its tiles are contiguous per batch row; the model's [R, B, C] append
+    layout is swapped outside (a ~MB-scale copy).
+
+    The ring must already contain the chunk's own k/v rows (the model appends
+    before attending — models/transformer.py mha_attention); chunk-internal
+    causality falls out of the position compares. Unwritten/stale slots must
+    be invalid in ``c_valid``/``r_valid``. GQA query head ``h`` reads KV head
+    ``h // (NH // KVH)``.
+    """
+    B, S, NH, D = q.shape
+    T0, KVH = ck.shape[2], ck.shape[3]
+    R = rk.shape[1]
+    groups = NH // KVH
+
+    block_q = min(block_q, _round_up(S, 8))
+    block_kv = min(block_kv, _round_up(T0, 128))
+    block_r = min(block_r, _round_up(R, 128))
+    # Scoped-VMEM budget: the dominant stack allocations are the unrolled
+    # per-head f32 score tiles, [block_q*groups, block] per KV head, for BOTH
+    # sources (Mosaic accounts the main and ring branches together). Cap each
+    # source's combined score footprint at ~4 MB of the ~16 MB scoped limit;
+    # block_q stays fixed (the positions BlockSpec needs a full or >=128-lane
+    # last dim), so only the kv blocks shrink.
+    budget = 5 * 1024 * 1024 // 2
+
+    def fit(blk: int) -> int:
+        while KVH * block_q * groups * blk * 4 > budget and blk > 128:
+            blk //= 2
+        return blk
+
+    block_kv = fit(block_kv)
+    block_r = fit(block_r)
+    s_pad = _round_up(S, block_q)
+    t_pad = _round_up(T0, block_kv)
+    r_pad = _round_up(R, block_r)
+    if s_pad != S:
+        q = jnp.pad(q, ((0, 0), (0, s_pad - S), (0, 0), (0, 0)))
+        q_pos = jnp.pad(q_pos, ((0, 0), (0, s_pad - S)))
+    # Only the 1-D mask operands are padded to block multiples; the K/V
+    # buffers stay untouched (padding the stacked cache would copy GBs) —
+    # Pallas clamp-pads out-of-range tails of the last data block, and those
+    # lanes are dead via the padded-False validity.
+    if t_pad != T0:
+        c_pos = jnp.pad(c_pos, ((0, 0), (0, t_pad - T0)))
+        c_valid = jnp.pad(c_valid, ((0, 0), (0, t_pad - T0)))
+    if r_pad != R:
+        r_pos = jnp.pad(r_pos, ((0, 0), (0, r_pad - R)))
+        r_valid = jnp.pad(r_valid, ((0, 0), (0, r_pad - R)))
+
+    n_main = t_pad // block_kv
+    n_ring = r_pad // block_r
+    grid = (B, s_pad // block_q, n_main + n_ring)
+
+    # Per-batch 1-D operands ride as [B, 1, X] so the block's second-minor
+    # dim equals the full dim (Mosaic's layout rule; same as ops.attention).
+    def row3(x):
+        return x.astype(jnp.int32)[:, None, :]
+
+    window_arr = jnp.asarray(
+        0 if window is None else window, jnp.int32
+    ).reshape(1)
+
+    main_ix = lambda t: jnp.minimum(t, n_main - 1)
+    ring_ix = lambda t: jnp.maximum(t - n_main, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _cached_kernel, scale=scale, softcap=softcap, groups=groups,
+            n_main=n_main,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # window
+            pl.BlockSpec((1, 1, block_q), lambda b, s, t: (b, 0, s)),  # q_pos
+            pl.BlockSpec((1, 1, block_kv), lambda b, s, t: (b, 0, main_ix(t))),
+            pl.BlockSpec((1, 1, block_kv), lambda b, s, t: (b, 0, main_ix(t))),
+            pl.BlockSpec((1, 1, block_r), lambda b, s, t: (b, 0, ring_ix(t))),
+            pl.BlockSpec((1, 1, block_r), lambda b, s, t: (b, 0, ring_ix(t))),
+            pl.BlockSpec(
+                (1, block_q, NH, D), lambda b, s, t: (b, s, 0, 0)
+            ),  # q
+            pl.BlockSpec(
+                (1, 1, block_kv, KVH, D),
+                lambda b, s, t: (layer, b, main_ix(t), 0, 0),
+            ),  # ck (full stack; static layer)
+            pl.BlockSpec(
+                (1, 1, block_kv, KVH, D),
+                lambda b, s, t: (layer, b, main_ix(t), 0, 0),
+            ),  # cv
+            pl.BlockSpec(
+                (1, block_r, KVH, D), lambda b, s, t: (b, ring_ix(t), 0, 0)
+            ),  # rk
+            pl.BlockSpec(
+                (1, block_r, KVH, D), lambda b, s, t: (b, ring_ix(t), 0, 0)
+            ),  # rv
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, NH, D), lambda b, s, t: (b, s, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, s_pad, NH, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((KVH, block_q * groups, 1), jnp.float32),  # running max
+            pltpu.VMEM((KVH, block_q * groups, 1), jnp.float32),  # running sum
+            pltpu.VMEM((KVH, block_q * groups, D), jnp.float32),  # accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        window_arr, row3(q_pos), row3(c_pos), row3(c_valid), row3(r_pos),
+        row3(r_valid), q, ck, cv, rk, rv,
+    )
+    return out[:, :S]
+
+
+def xla_cached_attention(
+    q, ck, cv, c_pos, c_valid, rk, rv, r_pos, r_valid, q_pos,
+    *, layer=0, scale, softcap=None, window=None,
+) -> jax.Array:
+    """Correctness oracle: concatenate (main ⊕ ring) into one KV sequence and
+    run the shared position-space XLA attention (ops.attention). Takes the
+    same operands as the kernel (stacked cache + static layer, batch-major
+    ring)."""
+    from introspective_awareness_tpu.ops.attention import xla_attention
+
+    dt = q.dtype
+    k = jnp.concatenate([ck[layer].astype(dt), rk.astype(dt)], axis=1)
+    v = jnp.concatenate([cv[layer].astype(dt), rv.astype(dt)], axis=1)
+    kv_pos = jnp.concatenate([c_pos, r_pos], axis=1)
+    kv_valid = jnp.concatenate(
+        [c_valid.astype(jnp.int32), r_valid.astype(jnp.int32)], axis=1
+    )
+    return xla_attention(
+        q, k, v, q_pos, kv_pos, kv_valid,
+        scale=scale, softcap=softcap, window=window,
+    )

@@ -30,15 +30,33 @@ from introspective_awareness_tpu.models.transformer import (
     forward,
     init_cache,
     make_positions,
+    merge_chunk,
     merge_ring,
 )
 
-# Decode steps between ring merges (the ring capacity). Per-step KV appends
-# touch only a [L, RING_CHUNK, B, heads*dim] scratch — XLA's slot-minor
-# layout choice makes single-slot writes into a big [.., T, ..] buffer a
-# read-modify-write of the whole per-layer slab, so the big buffer takes one
-# chunked append every RING_CHUNK steps instead (see KVCache / merge_ring).
+# Decode steps per chunk. Per-step KV appends touch only the small chunk
+# ring; at each chunk boundary the chunk is folded into the MERGED decode
+# buffer (models.transformer.merge_chunk — its read-modify-write slab is
+# bounded by the decode length, never the prompt) and the outer while_loop
+# re-checks "every row done" for EOS early exit. The experimental
+# flash_cached kernel path instead sizes the chunk ring for the whole
+# generation and never merges (position-space validity stays exact).
 RING_CHUNK = 16
+
+
+def _use_merged(cfg) -> bool:
+    """The merged tier is skipped ONLY when the fused cached-attention
+    kernel actually engages (it requires the whole generation in the chunk
+    ring). The kernel is wired into the non-MLA MHA branch on tpu/cpu
+    backends (models.transformer.mha_attention); any other combination must
+    keep merging or the einsum fallback decodes over a whole-generation
+    ring — the per-step RMW pathology the merged tier exists to avoid."""
+    kernel_engages = (
+        cfg.attn_impl == "flash_cached"
+        and not cfg.is_mla
+        and jax.default_backend() in ("tpu", "cpu")
+    )
+    return not kernel_engages
 
 
 class GenSpec(NamedTuple):
@@ -178,7 +196,9 @@ def _sample_and_decode(
         cache, prev, done, key, tokens, tail = lax.fori_loop(
             0, ch, inner, (cache, prev, done, key, tokens, tail)
         )
-        return cc + 1, merge_ring(cache, cfg), prev, done, key, tokens, tail
+        if _use_merged(cfg):
+            cache = merge_chunk(cache, cfg)
+        return cc + 1, cache, prev, done, key, tokens, tail
 
     if max_new_tokens > 1:
         carry = (jnp.int32(0), cache, tok0, done0, key, tokens0, tail0)
@@ -206,10 +226,14 @@ def generate_tokens(
 
     steer_prompt, steer_decode = _steer_specs(spec, mask)
     n_chunks, ch = _chunk_plan(max_new_tokens)
-    # The main slot buffer holds the prompt plus every merged chunk; the last
-    # chunk may overrun past max_new (those slots are written but the outer
-    # loop ends before anything could read them).
-    cache = init_cache(cfg, B, S + n_chunks * ch, dtype, ring_len=ch)
+    # Main slots hold just the prompt; decode tokens live in the chunk ring
+    # + merged buffer (see RING_CHUNK).
+    if _use_merged(cfg):
+        cache = init_cache(
+            cfg, B, S, dtype, ring_len=ch, merged_len=n_chunks * ch
+        )
+    else:
+        cache = init_cache(cfg, B, S, dtype, ring_len=n_chunks * ch)
     r = forward(
         params, cfg, ids, mask, positions,
         cache=cache, steer=steer_prompt, use_cache=True, logits_mode="last",
@@ -263,8 +287,8 @@ def generate_tokens_prefix(
 
     n_chunks, ch = _chunk_plan(max_new_tokens)
     # The suffix chunk needs an Ss-slot ring; decode then swaps in a fresh
-    # ch-slot ring (below) so per-step ring reads/appends stay small.
-    T = P0 + Ss + n_chunks * ch
+    # whole-generation ring (below, never merged — see RING_CHUNK).
+    T = P0 + Ss
     cache = init_cache(cfg, B, T, dtype, ring_len=Ss)
 
     # 2) Broadcast the prefix KV into every row's slots [0, P0).
@@ -292,15 +316,24 @@ def generate_tokens_prefix(
         cache=cache, steer=steer_prompt, use_cache=True, logits_mode="last",
     )
     cache = merge_ring(r.cache, cfg)
-    # Swap the (suffix-sized) ring for a decode-sized one: decode attention
-    # reads and appends scale with ring capacity, so carrying Ss slots
-    # through every decode step would cost ~Ss/ch x the ring traffic.
+    # Swap the (suffix-sized) ring for fresh decode tiers: the suffix rows
+    # now live in the main slots; decode starts from an all-invalid chunk
+    # ring (+ merged buffer, unless the fused kernel path is active — it
+    # needs the whole generation in the chunk ring).
+    RD = n_chunks * ch
+    RC = ch if _use_merged(cfg) else RD
+    RM = RD if _use_merged(cfg) else 0
     cache = cache._replace(
-        rk=jnp.zeros((L, ch, B, cache.rk.shape[-1]), cache.rk.dtype),
-        rv=jnp.zeros((L, ch, B, cache.rv.shape[-1]), cache.rv.dtype),
-        rpos=jnp.zeros((B, ch), jnp.int32),
-        rvalid=jnp.zeros((B, ch), jnp.bool_),
+        rk=jnp.zeros((L, RC, B) + cache.rk.shape[3:], cache.rk.dtype),
+        rv=jnp.zeros((L, RC, B) + cache.rv.shape[3:], cache.rv.dtype),
+        rpos=jnp.zeros((B, RC), jnp.int32),
+        rvalid=jnp.zeros((B, RC), jnp.bool_),
         rlen=jnp.int32(0),
+        mk=jnp.zeros((L, RM, B) + cache.mk.shape[3:], cache.mk.dtype),
+        mv=jnp.zeros((L, RM, B) + cache.mv.shape[3:], cache.mv.dtype),
+        mpos=jnp.zeros((B, RM), jnp.int32),
+        mvalid=jnp.zeros((B, RM), jnp.bool_),
+        mlen=jnp.int32(0),
     )
     true_len = P0 + suffix_mask.sum(axis=1).astype(jnp.int32)
     return _sample_and_decode(

@@ -1,0 +1,244 @@
+"""Hardware characterization probes for the decode-attention design space.
+
+Measures, on the real chip: (a) HBM bytes allocated per candidate KV-cache
+layout (lane-padding check), (b) achievable streaming bandwidth of a minimal
+Pallas kernel by tile structure and dtype (device-time parsed from profiler
+traces — wall clock through the axon tunnel is dispatch-latency-bound).
+
+Findings on v5e (2026-07, JAX 0.8.x) that shaped models/transformer.py and
+ops/cached_attention.py — re-run after toolchain bumps:
+
+- [block, KVH*D]-folded contiguous tiles stream at 566 GB/s (fp8) / 742
+  (bf16); per-head [T, D] tiles only reach 185 GB/s (64 KB DMAs).
+- fp8(e4m3) -> anything conversion in Mosaic runs at 73 GB/s effective (no
+  native VPU path) — a Pallas kernel CANNOT beat XLA's fused fp8 einsum
+  decode (~700 GB/s effective including conversion). int8 converts at 427,
+  bf16 needs none (655 through a dot).
+- Hence: the production decode stays on the XLA einsum over the fp8 cache;
+  the fused cached-attention kernel is opt-in (attn_impl=flash_cached).
+"""
+
+from __future__ import annotations
+
+import functools
+import glob
+import gzip
+import json
+import os
+import shutil
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from introspective_awareness_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache()
+
+B, T0, KVH, D = 384, 512, 8, 64
+C = KVH * D
+TRACE = "/tmp/iat_kprobe2"
+N = 20
+
+
+def mem_delta(make):
+    dev = jax.local_devices()[0]
+    base = dev.memory_stats()["bytes_in_use"]
+    x = make()
+    jax.block_until_ready(x)
+    used = dev.memory_stats()["bytes_in_use"] - base
+    del x
+    return used
+
+
+def layout_check():
+    for name, shape in [
+        ("[B,T0,KVH,D]", (B, T0, KVH, D)),
+        ("[B,KVH,T0,D]", (B, KVH, T0, D)),
+        ("[B,KVH,D,T0]", (B, KVH, D, T0)),
+        ("[B,T0,C]", (B, T0, C)),
+    ]:
+        logical = int(np.prod(shape))
+        for dt, bs in ((jnp.float8_e4m3fn, 1), (jnp.bfloat16, 2)):
+            used = mem_delta(lambda: jnp.zeros(shape, dt))
+            print(f"  {name} {dt.__name__}: logical {logical*bs/1e6:.1f} MB, "
+                  f"allocated {used/1e6:.1f} MB "
+                  f"({used/(logical*bs):.2f}x)")
+
+
+def device_total(trace_dir, key):
+    tot, n = 0.0, 0
+    for f in glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                       recursive=True):
+        with gzip.open(f, "rt") as fh:
+            t = json.load(fh)
+        pid_names = {}
+        for e in t["traceEvents"]:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pid_names[e["pid"]] = e["args"].get("name", "")
+        dev = {p for p, nm in pid_names.items() if "TPU" in nm}
+        for e in t["traceEvents"]:
+            if e.get("ph") == "X" and e.get("pid") in dev and \
+                    e["name"].startswith(key):
+                a = e.get("args") or {}
+                d = a.get("device_duration_ps")
+                if d:
+                    tot += float(d) / 1e9
+                    n += 1
+    return tot, n
+
+
+def bw_probe(label, arr_shape, block, index_map, grid, dt=jnp.float8_e4m3fn,
+             mode="sum"):
+    """Minimal streaming kernel. mode="sum": convert+reduce every element
+    (VPU-bound ceiling); mode="touch": read one element per tile (pure DMA
+    rate)."""
+    x = jnp.ones(arr_shape, dt)
+
+    def kern(x_ref, o_ref, acc):
+        t = pl.program_id(len(grid) - 1)
+
+        @pl.when(t == 0)
+        def _():
+            acc[0, 0] = 0.0
+
+        if mode == "sum":
+            acc[0, 0] += jnp.sum(x_ref[...].astype(jnp.float32))
+        elif mode == "sumbf":
+            # two-step: fp8 -> bf16 (maybe-native) -> f32 reduce
+            acc[0, 0] += jnp.sum(
+                x_ref[...].astype(jnp.bfloat16).astype(jnp.float32)
+            )
+        elif mode == "dot":
+            # the kernel's actual pattern: convert to bf16, feed the MXU
+            y = x_ref[...].astype(jnp.bfloat16)
+            y2 = y.reshape(-1, y.shape[-1])
+            ones = jnp.ones((y2.shape[-1], 8), jnp.bfloat16)
+            r = jax.lax.dot_general(
+                y2, ones, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc[0, 0] += jnp.sum(r[:8, :])
+        elif mode == "bitcast":
+            # e4m3 -> bf16 exactly, via integer widening: bf16 bits =
+            # sign<<8 | (exp+mant)<<4, then scale by 2^(127-7) to fix the
+            # exponent bias. (No NaN handling: cache writers clamp.)
+            i16 = jax.lax.bitcast_convert_type(
+                x_ref[...], jnp.int8).astype(jnp.int16)
+            bits = ((i16 & 0x7F) << 4) | ((i16 & jnp.int16(-128)) << 8)
+            y = jax.lax.bitcast_convert_type(
+                bits.astype(jnp.uint16), jnp.bfloat16)
+            y = y * jnp.bfloat16(2.0 ** 120)
+            acc[0, 0] += jnp.sum(y.astype(jnp.float32))
+        else:  # touch: read an 8x128 corner — fixed tiny VPU cost per tile
+            ix = (0,) * (len(arr_shape) - 2) + (slice(0, 8), slice(0, 128))
+            acc[0, 0] += jnp.sum(x_ref[ix].astype(jnp.float32))
+
+        @pl.when(t == pl.num_programs(len(grid) - 1) - 1)
+        def _():
+            o_ref[0, 0] = acc[0, 0]
+
+    nb = int(np.prod(arr_shape)) * x.dtype.itemsize
+
+    @jax.jit
+    def f(x):
+        return pl.pallas_call(
+            kern,
+            grid=grid,
+            in_specs=[pl.BlockSpec(block, index_map)],
+            out_specs=pl.BlockSpec(
+                (1, 1), lambda *a: (0, 0), memory_space=pltpu.SMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            scratch_shapes=[pltpu.SMEM((1, 1), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",) * (len(grid) - 1) + ("arbitrary",),
+            ),
+        )(x)
+
+    out = f(x)
+    jax.block_until_ready(out)
+    shutil.rmtree(TRACE, ignore_errors=True)
+    with jax.profiler.trace(TRACE):
+        for _ in range(N):
+            out = f(x)
+        jax.block_until_ready(out)
+    # Find the kernel's device events: the non-jit op with the largest total.
+    agg = defaultdict(lambda: [0.0, 0])
+    for f2 in glob.glob(os.path.join(TRACE, "**", "*.trace.json.gz"),
+                        recursive=True):
+        with gzip.open(f2, "rt") as fh:
+            t = json.load(fh)
+        pid_names = {}
+        for e in t["traceEvents"]:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pid_names[e["pid"]] = e["args"].get("name", "")
+        dev = {p for p, nm in pid_names.items() if "TPU" in nm}
+        for e in t["traceEvents"]:
+            if e.get("ph") == "X" and e.get("pid") in dev:
+                a = e.get("args") or {}
+                d = a.get("device_duration_ps")
+                if d and not e["name"].startswith("jit_"):
+                    agg[e["name"]][0] += float(d) / 1e9
+                    agg[e["name"]][1] += 1
+    if not agg:
+        print(f"  {label}: no device events")
+        return
+    name, (tot, n) = max(agg.items(), key=lambda kv: kv[1][0])
+    ms = tot / max(n, 1)
+    print(f"  {label}: {ms:.3f} ms/call -> {nb / ms / 1e6:.0f} GB/s "
+          f"(n={n}, op={name[:30]})")
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "layout"):
+        print("== allocated bytes per layout ==")
+        layout_check()
+    if which in ("all", "bw"):
+        print("== streaming bandwidth by tile structure ==")
+        for mode in ("touch", "sum"):
+            for dt in (jnp.float8_e4m3fn, jnp.bfloat16):
+                bw_probe(
+                    f"[B,T0,C] (1,512,C) {dt.__name__} {mode}",
+                    (B, T0, C), (1, 512, C), lambda b, t: (b, t, 0),
+                    (B, T0 // 512), dt=dt, mode=mode,
+                )
+        for mode in ("touch", "sum"):
+            bw_probe(
+                f"[B,T0,C] (8,512,C) bf16 {mode}",
+                (B, T0, C), (8, 512, C), lambda b, t: (b, t, 0),
+                (B // 8, T0 // 512), dt=jnp.bfloat16, mode=mode,
+            )
+        bw_probe(
+            "[B,KVH,T0,D] (1,1,512,64) bf16 touch",
+            (B, KVH, T0, D), (1, 1, 512, D),
+            lambda b, h, t: (b, h, t, 0), (B, KVH, T0 // 512),
+            dt=jnp.bfloat16, mode="touch",
+        )
+        bw_probe(
+            "[B,T0,C] (1,512,C) int8 sum",
+            (B, T0, C), (1, 512, C), lambda b, t: (b, t, 0),
+            (B, T0 // 512), dt=jnp.int8, mode="sum",
+        )
+        for dt, mode in [
+            (jnp.float8_e4m3fn, "sumbf"),
+            (jnp.float8_e4m3fn, "dot"),
+            (jnp.int8, "dot"),
+            (jnp.bfloat16, "dot"),
+        ]:
+            bw_probe(
+                f"[B,T0,C] (1,512,C) {dt.__name__} {mode}",
+                (B, T0, C), (1, 512, C), lambda b, t: (b, t, 0),
+                (B, T0 // 512), dt=dt, mode=mode,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,172 @@
+"""Profile the decode hot loop op-by-op on the real chip.
+
+Reproduces bench.py's best config (int8 weights + fp8 KV, batch 384) and
+captures a jax.profiler trace of the steady-state decode, then parses the
+Chrome-trace JSON to attribute device time per op category. Run directly:
+
+    python scripts/profile_decode.py [--batch 384] [--max-new 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=384)
+    ap.add_argument("--max-new", type=int, default=40)
+    ap.add_argument("--trace-dir", default="/tmp/iat_decode_trace")
+    ap.add_argument("--bf16", action="store_true", help="skip int8/fp8kv")
+    args = ap.parse_args()
+
+    import jax
+
+    from introspective_awareness_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    import dataclasses
+
+    from introspective_awareness_tpu.models.config import ModelConfig
+    from introspective_awareness_tpu.models.quant import quantize_params
+    from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+    from introspective_awareness_tpu.models.transformer import init_params
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    cfg = ModelConfig(
+        vocab_size=128256, hidden_size=2048, n_layers=16, n_heads=32,
+        n_kv_heads=8, head_dim=64, mlp_hidden=8192, rope_theta=500000.0,
+        tie_embeddings=True, attn_impl="flash",
+    )
+    if not args.bf16:
+        cfg = dataclasses.replace(cfg, kv_cache_dtype="fp8")
+    dtype = jax.numpy.bfloat16
+    init = jax.jit(init_params, static_argnames=("cfg", "dtype"))
+    params = init(cfg, jax.random.key(0), dtype=dtype)
+    jax.block_until_ready(params)
+    if not args.bf16:
+        params = quantize_params(params, bits=8, dtype=dtype, include_embed=True)
+    tok = ByteTokenizer()
+    runner = ModelRunner(params, cfg, tok, model_name="profile-1b")
+
+    from bench import _build_workload
+
+    prompts, vecs, starts = _build_workload(cfg, tok, args.batch)
+
+    def run(seed):
+        return runner.generate_batch_with_multi_steering(
+            prompts, layer_idx=int(cfg.n_layers * 0.6),
+            steering_vectors=list(vecs), strength=4.0,
+            max_new_tokens=args.max_new, temperature=1.0,
+            steering_start_positions=starts, seed=seed,
+        )
+
+    t0 = time.perf_counter()
+    run(0)
+    print(f"warmup {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    t0 = time.perf_counter()
+    run(1)
+    dt = time.perf_counter() - t0
+    steps = args.max_new - 1
+    print(f"steady run: {dt:.2f}s, {1e3 * dt / args.max_new:.2f} ms/token",
+          file=sys.stderr)
+
+    import shutil
+
+    shutil.rmtree(args.trace_dir, ignore_errors=True)
+    with jax.profiler.trace(args.trace_dir):
+        run(2)
+
+    # Parse the Chrome trace: device-side op events carry durations.
+    traces = sorted(glob.glob(
+        os.path.join(args.trace_dir, "**", "*.trace.json.gz"), recursive=True))
+    if not traces:
+        print("no trace.json.gz found", file=sys.stderr)
+        return
+    with gzip.open(traces[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # Find device-lane pids (TensorCore).
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+    dev_pids = {p for p, n in pid_names.items()
+                if "TPU" in n or "/device" in n.lower()}
+
+    # Self-time accounting: events nest by (tid, ts); a parent's self time
+    # excludes its children. Leaves inside a `while` ancestor are decode ops.
+    per_tid: dict[tuple, list] = defaultdict(list)
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        per_tid[(e["pid"], e.get("tid"))].append(e)
+
+    rows = []  # (name, self_ms, in_while)
+    for evs in per_tid.values():
+        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+        stack: list = []  # (end_ts, child_sum_ref, in_while)
+        for e in evs:
+            ts, dur = e["ts"], e.get("dur", 0)
+            while stack and ts >= stack[-1][0]:
+                end, name, child_sum, dur_p, in_w = stack.pop()
+                rows.append((name, (dur_p - child_sum) / 1e3, in_w))
+            if stack:
+                stack[-1] = (stack[-1][0], stack[-1][1],
+                             stack[-1][2] + dur, stack[-1][3], stack[-1][4])
+            in_while = (stack[-1][4] if stack else False) or \
+                e["name"].startswith("while")
+            stack.append([ts + dur, e["name"], 0, dur, in_while])
+        while stack:
+            end, name, child_sum, dur_p, in_w = stack.pop()
+            rows.append((name, (dur_p - child_sum) / 1e3, in_w))
+
+    def cat_of(name: str) -> str:
+        ln = name.lower()
+        if "fusion" in ln and ("dot" in ln or "conv" in ln or "dus" in ln):
+            return "fused-matmul"
+        if ln.startswith(("dot", "convolution", "custom-call", "cublas")):
+            return "matmul"
+        if "copy" in ln or "transpose" in ln or "bitcast" in ln:
+            return "copy/transpose"
+        if "dynamic-update" in ln or "dynamic_update" in ln:
+            return "dus"
+        if "rng" in ln or "threefry" in ln:
+            return "rng"
+        if "reduce" in ln or "argmax" in ln or "sort" in ln or "iota" in ln:
+            return "reduce"
+        return "other"
+
+    for scope, in_w in (("DECODE (in while)", True), ("PREFILL/other", False)):
+        sel = [(n, v) for n, v, w in rows if w == in_w and v > 0]
+        total = sum(v for _, v in sel)
+        by_cat: dict[str, float] = defaultdict(float)
+        by_name: dict[str, float] = defaultdict(float)
+        for n, v in sel:
+            by_cat[cat_of(n)] += v
+            by_name[n] += v
+        hdr = f"\n== {scope}: {total:.1f} ms"
+        if in_w:
+            hdr += f" (~{total / max(steps, 1):.2f} ms/step)"
+        print(hdr)
+        for c, v in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+            print(f"  {c:16s} {v:9.1f} ms  ({100 * v / max(total, 1e-9):.0f}%)")
+        print("  -- top 20 ops --")
+        for n, v in sorted(by_name.items(), key=lambda kv: -kv[1])[:20]:
+            print(f"  {v:9.1f} ms  {n[:110]}")
+
+
+if __name__ == "__main__":
+    main()

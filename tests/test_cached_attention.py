@@ -1,0 +1,133 @@
+"""The fused cached-attention kernel (ops/cached_attention.py) vs its XLA
+oracle, and the flash_cached end-to-end decode path vs the einsum path.
+
+Runs the kernel in interpret mode on the CPU mesh. Unaligned T0/R cases are
+the NaN regression guard: Pallas pads out-of-range block tails with
+unspecified bits (NaN in interpret mode), which must never reach the
+accumulator (0 * NaN poisons dots — the kernel must where()-scrub v rows).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.ops.cached_attention import (
+    cached_attention,
+    xla_cached_attention,
+)
+
+
+def _case(L, B, S, T0, R, NH, KVH, D, fp8=False, window=None, softcap=None,
+          layer=0, seed=0):
+    r = np.random.default_rng(seed)
+    q = jnp.asarray(r.normal(size=(B, S, NH, D)), jnp.float32)
+    ck = jnp.asarray(r.normal(size=(L, B, T0, KVH, D)), jnp.float32)
+    cv = jnp.asarray(r.normal(size=(L, B, T0, KVH, D)), jnp.float32)
+    rk = jnp.asarray(r.normal(size=(B, R, KVH, D)), jnp.float32)
+    rv = jnp.asarray(r.normal(size=(B, R, KVH, D)), jnp.float32)
+    if fp8:
+        ck, cv, rk, rv = (a.astype(jnp.float8_e4m3fn) for a in (ck, cv, rk, rv))
+    # main: left-padded rows; ring: partially-written monotone continuation
+    pad = r.integers(0, max(T0 // 2, 1), size=B)
+    c_valid = np.zeros((B, T0), bool)
+    c_pos = np.zeros((B, T0), np.int32)
+    for b in range(B):
+        c_valid[b, pad[b]:] = True
+        c_pos[b, pad[b]:] = np.arange(T0 - pad[b])
+    rl = int(r.integers(1, R + 1))
+    r_valid = np.zeros((B, R), bool)
+    r_pos = np.zeros((B, R), np.int32)
+    for b in range(B):
+        r_valid[b, :rl] = r.random(rl) > 0.2
+        r_pos[b, :rl] = (T0 - pad[b]) + np.arange(rl)
+    q_pos = np.zeros((B, S), np.int32)
+    for b in range(B):
+        q_pos[b] = (T0 - pad[b]) + rl - S + np.arange(S)
+    args = (q, ck, cv, jnp.asarray(c_pos), jnp.asarray(c_valid), rk, rv,
+            jnp.asarray(r_pos), jnp.asarray(r_valid), jnp.asarray(q_pos))
+    kw = dict(layer=layer, scale=D**-0.5, softcap=softcap, window=window)
+    got = cached_attention(*args, **kw, interpret=True)
+    want = xla_cached_attention(*args, **kw)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want),
+        atol=3e-2 if fp8 else 2e-5, rtol=1e-3,
+    )
+
+
+@pytest.mark.parametrize(
+    "L,B,S,T0,R,NH,KVH,D,kw",
+    [
+        # decode shape, stacked layers, non-zero layer index
+        (2, 3, 1, 64, 16, 8, 2, 64, dict(layer=1)),
+        # UNALIGNED T0 and R: out-of-range block tails (NaN scrub guard)
+        (1, 2, 1, 20, 9, 4, 2, 64, dict()),
+        (3, 2, 4, 23, 8, 4, 2, 16, dict(layer=2)),
+        # suffix-chunk shape (S > 1), unaligned T0/R
+        (2, 2, 17, 70, 13, 8, 4, 64, dict()),
+        # fp8-stored cache
+        (1, 2, 1, 256, 128, 32, 8, 64, dict(fp8=True)),
+        # sliding window / softcap / MQA / D=128
+        (1, 2, 9, 130, 40, 4, 1, 64, dict(window=32)),
+        (1, 2, 5, 64, 8, 4, 4, 128, dict(softcap=50.0)),
+        # full-size suffix block
+        (1, 1, 128, 512, 128, 32, 8, 64, dict()),
+    ],
+)
+def test_kernel_matches_oracle(L, B, S, T0, R, NH, KVH, D, kw):
+    _case(L, B, S, T0, R, NH, KVH, D, **kw)
+
+
+def test_flash_cached_generation_token_identity():
+    """generate_tokens / generate_tokens_prefix produce IDENTICAL tokens with
+    attn_impl=flash_cached (fused kernel decode) and attn_impl=xla."""
+    from introspective_awareness_tpu.models.config import tiny_config
+    from introspective_awareness_tpu.models.transformer import init_params
+    from introspective_awareness_tpu.runtime.generate import (
+        GenSpec,
+        generate_tokens,
+        generate_tokens_prefix,
+    )
+
+    cfg = tiny_config(n_layers=4)
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    B, S = 3, 23
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, 200, size=(B, S)), jnp.int32)
+    m = np.ones((B, S), np.int32)
+    for b, p in enumerate([0, 3, 7]):
+        m[b, :p] = 0
+    mask = jnp.asarray(m)
+    ids = ids * mask
+    vecs = jnp.asarray(rng.normal(size=(B, cfg.hidden_size)), jnp.float32)
+    spec = GenSpec(
+        rng=jax.random.key(1), temperature=jnp.float32(0.0),
+        steer_layer=jnp.int32(2), steer_strength=jnp.float32(3.0),
+        steer_vectors=vecs, steer_start=jnp.asarray([5, 8, 9], jnp.int32),
+        eos_ids=jnp.asarray([9999], jnp.int32), pad_id=jnp.int32(0),
+    )
+    outs = {}
+    for impl in ("xla", "flash_cached"):
+        c = dataclasses.replace(cfg, attn_impl=impl)
+        outs[impl] = np.asarray(
+            generate_tokens(params, c, ids, mask, spec, max_new_tokens=12)
+        )
+    np.testing.assert_array_equal(outs["xla"], outs["flash_cached"])
+
+    # shared-prefix path + fp8 cache
+    prefix = ids[0, :11]
+    sfx, sm = ids[:, 11:], mask[:, 11:]
+    spec2 = spec._replace(steer_start=jnp.asarray([2, 3, 9], jnp.int32))
+    outs2 = {}
+    for impl in ("xla", "flash_cached"):
+        c = dataclasses.replace(
+            cfg, attn_impl=impl, kv_cache_dtype="fp8"
+        )
+        outs2[impl] = np.asarray(
+            generate_tokens_prefix(
+                params, c, prefix, sfx, sm, spec2, max_new_tokens=10
+            )
+        )
+    np.testing.assert_array_equal(outs2["xla"], outs2["flash_cached"])
