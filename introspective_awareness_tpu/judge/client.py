@@ -171,7 +171,16 @@ class OnDeviceJudgeClient:
     def grade(self, prompts: Sequence[str]) -> list[str]:
         out: list[str] = []
         for i in range(0, len(prompts), self.chunk_size):
-            chunk = prompts[i : i + self.chunk_size]
+            chunk = list(prompts[i : i + self.chunk_size])
+            n = len(chunk)
+            # Coarse batch buckets: stage-2 grades only the claimers, whose
+            # count varies run to run — padding to a multiple of 64 keeps
+            # the grader on a handful of compiled executables instead of
+            # recompiling per ragged batch size (the runner's own padding
+            # buckets at 8, which is too fine for a 500-token generate
+            # program).
+            pad_to = min(self.chunk_size, -(-n // 64) * 64)
+            chunk += [chunk[-1]] * (pad_to - n)
             rendered = [
                 self.runner.tokenizer.apply_chat_template(
                     [{"role": "user", "content": p}], add_generation_prompt=True
@@ -183,8 +192,8 @@ class OnDeviceJudgeClient:
                     self.runner.generate_batch(
                         rendered, max_new_tokens=self.max_tokens,
                         temperature=0.0, stop_strings=self.STOP_STRINGS,
-                    )
+                    )[:n]
                 )
             except Exception as e:  # noqa: BLE001 - contract: ERROR: strings
-                out.extend([f"ERROR: {e}"] * len(chunk))
+                out.extend([f"ERROR: {e}"] * n)
         return out

@@ -115,6 +115,16 @@ class ModelConfig:
     n_group: int = 1
     topk_group: int = 1
     moe_topk_method: str = "greedy"  # greedy | group_limited_greedy | noaux_tc
+    # Expert execution: "dense" runs every expert on every token and selects
+    # via a combine matrix (exact; the right baseline for eval batches).
+    # "topk" sort/segment-dispatches only the selected tokens into per-expert
+    # capacity buffers — expert FLOPs scale ~K*capacity_factor/E instead of
+    # E/E, the production choice for large expert counts (DeepSeek/Qwen-MoE
+    # class). Tokens beyond an expert's capacity are dropped (standard
+    # Switch/GShard semantics); capacity_factor ~>= E/K reproduces dense
+    # exactly.
+    moe_dispatch: str = "dense"  # dense | topk
+    moe_capacity_factor: float = 1.25
 
     @property
     def is_moe(self) -> bool:

@@ -106,8 +106,14 @@ class KVCache(NamedTuple):
     rpos: jax.Array  # [B, R] int32 — rope positions of ring slots
     rvalid: jax.Array  # [B, R] bool — real-token ring slots (pads False)
     rlen: jax.Array  # int32 scalar — next ring write slot
-    mk: jax.Array  # [L, RM, B, KVH, KD] — merged decode slots (RM may be 0)
-    mv: jax.Array  # [L, RM, B, KVH, VD]
+    # Merged tier is PAGED: one page per folded chunk. A merge then
+    # replaces an entire page-dim slice — tile-complete under any XLA
+    # layout choice, so no read-modify-write of previously merged pages
+    # (a flat [L, RM, B, ...] merged buffer got a slot-minor layout from
+    # the attention reads and each merge rewrote the whole slab,
+    # ~2.9 ms/step at batch 384 / 100 new tokens on v5e).
+    mk: jax.Array  # [L, P, ch, B, KVH, KD] — merged decode pages (P may be 0)
+    mv: jax.Array  # [L, P, ch, B, KVH, VD]
     mpos: jax.Array  # [B, RM] int32
     mvalid: jax.Array  # [B, RM] bool
     mlen: jax.Array  # int32 scalar — next merged write slot
@@ -172,17 +178,18 @@ def merge_chunk(cache: KVCache, cfg: ModelConfig) -> KVCache:
     the decode length), not the prompt-sized prefill buffer."""
     L, RR, B = cache.rk.shape[:3]
     vd = cache.v.shape[-1]
-    # Chunk ring and merged buffer share the slot-leading layout, so the
-    # fold is a direct contiguous multi-slab copy — no transpose, and any
-    # read-modify-write is bounded by the merged slab, amortized over the
-    # chunk.
+    # The chunk becomes one whole page: the update spans every non-page
+    # dim, so the write is tile-complete and XLA never reads back
+    # previously merged pages.
+    page = cache.mlen // RR
     new_mk = lax.dynamic_update_slice(
-        cache.mk, cache.rk.astype(cache.mk.dtype), (0, cache.mlen, 0, 0, 0)
+        cache.mk, cache.rk.astype(cache.mk.dtype)[:, None],
+        (0, page, 0, 0, 0, 0),
     )
     if vd:
         new_mv = lax.dynamic_update_slice(
-            cache.mv, cache.rv.astype(cache.mv.dtype),
-            (0, cache.mlen, 0, 0, 0),
+            cache.mv, cache.rv.astype(cache.mv.dtype)[:, None],
+            (0, page, 0, 0, 0, 0),
         )
     else:
         new_mv = cache.mv
@@ -200,13 +207,14 @@ def merge_chunk(cache: KVCache, cfg: ModelConfig) -> KVCache:
 
 def init_cache(
     cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.float32,
-    ring_len: int = 0, merged_len: int = 0,
+    ring_len: int = 0, merged_pages: int = 0,
 ) -> KVCache:
     """MHA caches per-head k/v; MLA caches one row of compressed-kv + shared
     rope key per token (``v`` is unused and kept zero-width). ``max_len``
     sizes the prefill part, ``ring_len`` the append chunk ring, and
-    ``merged_len`` the merged decode buffer (0 when the caller never calls
-    ``merge_chunk``, e.g. single-chunk decodes or the suffix pass).
+    ``merged_pages`` the page count of the merged decode buffer (0 when the
+    caller never calls ``merge_chunk``, e.g. single-chunk decodes or the
+    suffix pass).
     ``cfg.kv_cache_dtype="fp8"`` stores the payload as float8_e4m3fn
     (writers .astype into the buffers; readers convert back — see the
     attention fns)."""
@@ -226,10 +234,10 @@ def init_cache(
         rpos=jnp.zeros((batch, ring_len), jnp.int32),
         rvalid=jnp.zeros((batch, ring_len), jnp.bool_),
         rlen=jnp.int32(0),
-        mk=jnp.zeros((L, merged_len, batch, kvh, kd), dtype),
-        mv=jnp.zeros((L, merged_len, batch, kvh, vd), dtype),
-        mpos=jnp.zeros((batch, merged_len), jnp.int32),
-        mvalid=jnp.zeros((batch, merged_len), jnp.bool_),
+        mk=jnp.zeros((L, merged_pages, ring_len, batch, kvh, kd), dtype),
+        mv=jnp.zeros((L, merged_pages, ring_len, batch, kvh, vd), dtype),
+        mpos=jnp.zeros((batch, merged_pages * ring_len), jnp.int32),
+        mvalid=jnp.zeros((batch, merged_pages * ring_len), jnp.bool_),
         mlen=jnp.int32(0),
     )
 
@@ -555,9 +563,9 @@ def _attention_decode(
     rv: jax.Array,
     m_ring: jax.Array,  # [B, S, R]
     cfg: ModelConfig,
-    mk: jax.Array | None = None,  # [RM, B, KVH, D] merged decode slots
+    mk: jax.Array | None = None,  # [P, ch, B, KVH, D] merged decode pages
     mv: jax.Array | None = None,
-    m_merged: jax.Array | None = None,  # [B, S, RM]
+    m_merged: jax.Array | None = None,  # [B, S, P*ch]
 ) -> jax.Array:
     """Decode attention over (frozen prefill slots ⊕ merged decode slots ⊕
     chunk ring) under one shared softmax. The current chunk's rows are
@@ -570,7 +578,7 @@ def _attention_decode(
     groups = NH // KVH
     qg = q.reshape(B, S, KVH, groups, D)
     scale = cfg.query_scale if cfg.query_scale is not None else D**-0.5
-    use_merged = mk is not None and mk.shape[0] > 0
+    use_merged = mk is not None and mk.shape[0] * mk.shape[1] > 0
     # fp8-stored caches convert back at the dot (the convert fuses into the
     # operand read; the HBM stream stays fp8-sized).
     cast = lambda a: a.astype(q.dtype) if a.dtype != q.dtype else a
@@ -586,15 +594,26 @@ def _attention_decode(
     parts = [part("bskgd,btkd->bkgst", k_old, m_old)]
     if use_merged:
         mk, mv = cast(mk), cast(mv)
-        parts.append(part("bskgd,rbkd->bkgsr", mk, m_merged))
+        P, CH = mk.shape[0], mk.shape[1]
+        s_m = jnp.einsum(
+            "bskgd,pcbkd->bkgspc", qg, mk, preferred_element_type=jnp.float32
+        ) * scale
+        s_m = s_m.reshape(*s_m.shape[:4], P * CH)
+        if cfg.attn_logit_softcap:
+            cap = cfg.attn_logit_softcap
+            s_m = cap * jnp.tanh(s_m / cap)
+        parts.append(
+            jnp.where(m_merged[:, None, None, :, :], s_m, _NEG_INF)
+        )
     parts.append(part("bskgd,rbkd->bkgsr", rk, m_ring))
     scores = jnp.concatenate(parts, axis=-1)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     T0 = k_old.shape[1]
-    TM = T0 + (mk.shape[0] if use_merged else 0)
+    TM = T0 + (P * CH if use_merged else 0)
     out = jnp.einsum("bkgst,btkd->bskgd", probs[..., :T0], v_old)
     if use_merged:
-        out = out + jnp.einsum("bkgsr,rbkd->bskgd", probs[..., T0:TM], mv)
+        pm = probs[..., T0:TM].reshape(*probs.shape[:4], P, CH)
+        out = out + jnp.einsum("bkgspc,pcbkd->bskgd", pm, mv)
     out = out + jnp.einsum("bkgsr,rbkd->bskgd", probs[..., TM:], rv)
     return out.reshape(B, S, NH, v_old.shape[-1])
 
@@ -612,7 +631,9 @@ class ForwardResult(NamedTuple):
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "use_cache", "capture", "logits_mode", "is_prefill"),
+    static_argnames=(
+        "cfg", "use_cache", "capture", "logits_mode", "is_prefill", "sp_mesh"
+    ),
     # The KV cache is consumed and replaced every step; donation lets XLA
     # update it in place instead of holding two full [L,B,T,KVH,D] copies.
     donate_argnames=("cache",),
@@ -633,6 +654,7 @@ def forward(
     capture: bool = False,
     logits_mode: str = "last",  # "last" | "all" | "none" | "hidden"
     is_prefill: bool = False,
+    sp_mesh=None,  # jax.sharding.Mesh with a seq axis > 1 → ring attention
 ) -> ForwardResult:
     """One traced forward covering extraction, prefill, decode, and
     pipeline stages.
@@ -652,6 +674,12 @@ def forward(
       local layers; ``layer_offset`` (may be traced, e.g. stage *
       layers-per-stage) keeps steering layer gating and sliding-window
       periodicity on GLOBAL layer indices. No-cache only.
+    - ``sp_mesh``: a mesh whose ``seq`` axis is > 1 routes S > 1 attention
+      through ring attention (ops/ring.py) — the chunk's Q/K/V shard over
+      the sequence axis and K/V rotate over ICI, so long-context prefill and
+      extraction run sequence-parallel (SURVEY §5.7). Decode steps (S == 1)
+      keep the einsum over the (seq-replicated) cache. MHA only, no sliding
+      window.
     """
     B, S = ids.shape
     dtype = params["embed"].dtype
@@ -723,7 +751,7 @@ def forward(
             )
             # Merged decode slots: all strictly earlier (written at chunk
             # boundaries), gated by write count + per-row validity.
-            RM = cache.mk.shape[1]
+            RM = cache.mk.shape[1] * cache.mk.shape[2]
             allowed_merged = jnp.broadcast_to(
                 (
                     (jnp.arange(RM, dtype=jnp.int32)[None, :] < cache.mlen)
@@ -853,7 +881,7 @@ def forward(
                 # sizes it so for flash_cached): slots at or past the append
                 # point have never been written, so position-space validity
                 # is exact; the merged tier must be empty.
-                assert cache.mk.shape[1] == 0, (
+                assert cache.mk.shape[1] * cache.mk.shape[2] == 0, (
                     "flash_cached requires merged_len=0 (whole-generation "
                     "chunk ring)"
                 )
@@ -894,6 +922,30 @@ def forward(
                 mk=cache.mk[l], mv=cache.mv[l], m_merged=amask_merged,
             )
             return attn, rk_full, rv_full
+        elif sp_mesh is not None and S > 1:
+            # Sequence-parallel chunk attention: Q/K/V shard over the mesh
+            # seq axis; K/V shards rotate over ICI (ops/ring.py). Position-
+            # space causality makes left padding free. Composes with dp/tp
+            # through the batch/head axis specs.
+            assert cfg.sliding_window is None, (
+                "ring attention path has no sliding-window support"
+            )
+            from introspective_awareness_tpu.ops.ring import ring_attention
+            from introspective_awareness_tpu.parallel.mesh import (
+                DATA_AXIS,
+                MODEL_AXIS,
+                SEQ_AXIS,
+            )
+
+            attn = ring_attention(
+                q, k, v, positions, attn_mask, sp_mesh,
+                scale=cfg.query_scale if cfg.query_scale is not None
+                else cfg.head_dim**-0.5,
+                softcap=cfg.attn_logit_softcap,
+                axis_name=SEQ_AXIS,
+                batch_axis=DATA_AXIS,
+                head_axis=MODEL_AXIS,
+            )
         elif use_flash:
             # Pallas fused attention over the current chunk; causal +
             # left-padding + per-layer sliding window are position-space
@@ -986,16 +1038,20 @@ def forward(
             s_ring = jnp.where(allowed_ring[:, None, :, :], s_ring, _NEG_INF)
 
             parts = [part(cc_old, kr_old, allowed_old)]
-            use_merged = cache.mk.shape[1] > 0
+            use_merged = cache.mk.shape[1] * cache.mk.shape[2] > 0
             if use_merged:
-                cc_m = cache.mk[l][:, :, 0, :R].astype(x.dtype)  # [RM, B, Rk]
-                kr_m = cache.mk[l][:, :, 0, R:].astype(x.dtype)
+                # [P, ch, B, 1, C] pages -> compressed/rope splits
+                mk_l = cache.mk[l]
+                PM, CHM = mk_l.shape[0], mk_l.shape[1]
+                cc_m = mk_l[:, :, :, 0, :R].astype(x.dtype)  # [P, ch, B, Rk]
+                kr_m = mk_l[:, :, :, 0, R:].astype(x.dtype)
                 s_m = (
-                    jnp.einsum("bsnr,obr->bnso", q_abs, cc_m,
+                    jnp.einsum("bsnr,pcbr->bnspc", q_abs, cc_m,
                                preferred_element_type=jnp.float32)
-                    + jnp.einsum("bsnd,obd->bnso", q_rot, kr_m,
+                    + jnp.einsum("bsnd,pcbd->bnspc", q_rot, kr_m,
                                  preferred_element_type=jnp.float32)
                 ) * scale
+                s_m = s_m.reshape(*s_m.shape[:3], PM * CHM)
                 parts.append(
                     jnp.where(allowed_merged[:, None, :, :], s_m, _NEG_INF)
                 )
@@ -1003,10 +1059,11 @@ def forward(
             scores = jnp.concatenate(parts, axis=-1)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             T = cc_old.shape[1]
-            TM = T + (cc_m.shape[0] if use_merged else 0)
+            TM = T + (PM * CHM if use_merged else 0)
             ctx = jnp.einsum("bnst,btr->bsnr", probs[..., :T], cc_old)
             if use_merged:
-                ctx = ctx + jnp.einsum("bnso,obr->bsnr", probs[..., T:TM], cc_m)
+                pm = probs[..., T:TM].reshape(*probs.shape[:3], PM, CHM)
+                ctx = ctx + jnp.einsum("bnspc,pcbr->bsnr", pm, cc_m)
             ctx = ctx + jnp.einsum("bnso,obr->bsnr", probs[..., TM:], cc_ring)
             attn = jnp.einsum("bsnr,rnd->bsnd", ctx, wv_b)  # [B,S,NH,VD]
             return attn, rk_full
@@ -1219,8 +1276,16 @@ def _moe_mlp(x: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
     topv, topi = lax.top_k(probs, cfg.n_experts_per_tok)  # [B,S,K]
     if cfg.moe_norm_topk_prob:
         topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    return _experts_topk(x, lp, cfg, topi, topv)
+
+
+def _experts_topk(x, lp, cfg, topi, weights):
+    """Expert execution from top-k choices, by cfg.moe_dispatch."""
+    if cfg.moe_dispatch == "topk":
+        return _experts_dispatch(x, lp, cfg, topi, weights)
     combine = jnp.sum(
-        jax.nn.one_hot(topi, cfg.n_experts, dtype=x.dtype) * topv[..., None].astype(x.dtype),
+        jax.nn.one_hot(topi, cfg.n_experts, dtype=x.dtype)
+        * weights[..., None].astype(x.dtype),
         axis=2,
     )  # [B, S, E]
     return _experts_combine(x, lp, cfg, combine)
@@ -1235,6 +1300,57 @@ def _experts_combine(x, lp, cfg, combine):
     act = mlp_act(gate, cfg) * up
     eo = jnp.einsum("ebsm,emh->ebsh", act, W(lp["w_down"]))
     return jnp.einsum("ebsh,bse->bsh", eo, combine)
+
+
+def _experts_dispatch(x, lp, cfg, topi, weights):
+    """Sort/segment top-k dispatch (VERDICT r4 #7; Switch/GShard semantics).
+
+    Assignments sort by expert id into per-expert CAPACITY buffers; each
+    expert's FFN runs only over its buffer, so expert FLOPs scale with
+    K * capacity_factor / E of the dense-combine formulation instead of 1.
+    Tokens past an expert's capacity are dropped (their weight contributes
+    nothing) — standard dispatch semantics; ``moe_capacity_factor`` sizes
+    the buffers. Static shapes throughout (argsort + bincount + scatter), so
+    the whole path jits and shards: the [E, C, H] buffers inherit the
+    ``expert``-axis sharding from the expert weights.
+    """
+    B, S, H = x.shape
+    E, K = cfg.n_experts, cfg.n_experts_per_tok
+    N = B * S
+    NK = N * K
+    C = max(8, int(-(-NK * cfg.moe_capacity_factor // E)))
+    xf = x.reshape(N, H)
+
+    flat_e = topi.reshape(NK)
+    flat_w = weights.reshape(NK)
+    flat_tok = jnp.repeat(jnp.arange(N, dtype=jnp.int32), K)
+    order = jnp.argsort(flat_e, stable=True)  # group assignments by expert
+    se = flat_e[order]
+    st = flat_tok[order]
+    sw = flat_w[order]
+    counts = jnp.bincount(flat_e, length=E)
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(NK, dtype=jnp.int32) - starts[se].astype(jnp.int32)
+    keep = pos < C
+    # Overflowing assignments route to a trash row PAST the buffers — a
+    # clamped in-range slot could overwrite a kept token's row.
+    slot = jnp.where(keep, se.astype(jnp.int32) * C + pos, E * C)
+
+    xbuf = jnp.zeros((E * C + 1, H), x.dtype).at[slot].set(xf[st])
+    ebuf = xbuf[: E * C].reshape(E, C, H)
+    gate = jnp.einsum("ech,ehm->ecm", ebuf, W(lp["w_gate"]))
+    up = jnp.einsum("ech,ehm->ecm", ebuf, W(lp["w_up"]))
+    act = mlp_act(gate, cfg) * up
+    eo = jnp.einsum("ecm,emh->ech", act, W(lp["w_down"]))  # [E, C, H]
+
+    yflat = eo.reshape(E * C, H)
+    contrib = jnp.where(
+        keep[:, None],
+        yflat[jnp.minimum(slot, E * C - 1)] * sw[:, None].astype(x.dtype),
+        0,
+    )
+    y = jnp.zeros((N, H), x.dtype).at[st].add(contrib)
+    return y.reshape(B, S, H)
 
 
 def _deepseek_moe(x: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
@@ -1277,11 +1393,7 @@ def _deepseek_moe(x: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
     if cfg.moe_norm_topk_prob:
         weights = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-20)
     weights = weights * cfg.routed_scaling_factor
-    combine = jnp.sum(
-        jax.nn.one_hot(topi, E, dtype=x.dtype) * weights[..., None].astype(x.dtype),
-        axis=2,
-    )
-    routed = _experts_combine(x, lp, cfg, combine)
+    routed = _experts_topk(x, lp, cfg, topi, weights)
     if not cfg.n_shared_experts:
         return routed
 

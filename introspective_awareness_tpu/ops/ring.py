@@ -43,7 +43,7 @@ def _partial_attention(q, k, v, qp, kp, kv_valid, scale, softcap):
     return m, l, acc
 
 
-def _ring_body(q, k, v, qp, kp, kv_valid, *, axis_name, scale, softcap):
+def _ring_body(q, k, v, qp, kp, kv_valid, *, axis_name, varying_axes, scale, softcap):
     """Runs inside shard_map: local blocks only; K/V rotate around the ring."""
     n = jax.lax.psum(1, axis_name)
     B, S, NH, D = q.shape
@@ -51,11 +51,12 @@ def _ring_body(q, k, v, qp, kp, kv_valid, *, axis_name, scale, softcap):
     m = jnp.full((B, k.shape[2], NH // k.shape[2], S, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros_like(m)
     acc = jnp.zeros((B, k.shape[2], NH // k.shape[2], S, D), jnp.float32)
-    # The online-softmax state is per-shard data: mark it varying over the
-    # ring axis so the loop carry type matches the (varying) step outputs.
+    # The online-softmax state is per-shard data: mark it varying over every
+    # manual axis the inputs vary over (seq, plus any batch/head axes) so the
+    # loop carry type matches the (varying) step outputs.
     from introspective_awareness_tpu.parallel.sharding import mark_varying
 
-    m, l, acc = mark_varying((m, l, acc), axis_name)
+    m, l, acc = mark_varying((m, l, acc), varying_axes)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -92,20 +93,30 @@ def ring_attention(
     scale: float,
     softcap: float | None = None,
     axis_name: str = "seq",
+    batch_axis: str | None = None,
+    head_axis: str | None = None,
 ) -> jax.Array:
     """Sequence-parallel attention over ``mesh[axis_name]``.
 
     Inputs are global arrays; shard_map splits the sequence dim across the
     ring, and the result comes back with the same (sequence-sharded)
     layout. Numerically equals full causal attention.
+
+    ``batch_axis``/``head_axis`` name mesh axes the batch and head dims are
+    ALSO sharded over (the model runtime composes sp with dp/tp); the ring
+    only ever communicates over ``axis_name``.
     """
     shard_map = jax.shard_map
 
-    seq_spec = P(None, axis_name, None, None)
-    pos_spec = P(None, axis_name)
+    seq_spec = P(batch_axis, axis_name, head_axis, None)
+    pos_spec = P(batch_axis, axis_name)
+    varying = tuple(
+        a for a in (axis_name, batch_axis, head_axis) if a is not None
+    )
     fn = shard_map(
         functools.partial(
-            _ring_body, axis_name=axis_name, scale=scale, softcap=softcap
+            _ring_body, axis_name=axis_name, varying_axes=varying,
+            scale=scale, softcap=softcap,
         ),
         mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec, pos_spec, pos_spec, pos_spec),

@@ -208,7 +208,7 @@ def _sample_and_decode(
     return tokens[:, :max_new_tokens]
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "sp_mesh"))
 def generate_tokens(
     params: dict,
     cfg: ModelConfig,
@@ -217,8 +217,13 @@ def generate_tokens(
     spec: GenSpec,
     *,
     max_new_tokens: int,
+    sp_mesh=None,  # Mesh with seq axis > 1: ring-attention prefill
 ) -> jax.Array:
-    """Returns generated token ids ``[B, max_new_tokens]`` (pad after EOS)."""
+    """Returns generated token ids ``[B, max_new_tokens]`` (pad after EOS).
+
+    With ``sp_mesh``, the prefill chunk attends via ring attention over the
+    mesh seq axis (long-context sequence parallelism); decode steps read the
+    seq-sharded cache through GSPMD collectives."""
     B, S = ids.shape
     positions = make_positions(mask)
     true_len = mask.sum(axis=1).astype(jnp.int32)
@@ -230,14 +235,14 @@ def generate_tokens(
     # + merged buffer (see RING_CHUNK).
     if _use_merged(cfg):
         cache = init_cache(
-            cfg, B, S, dtype, ring_len=ch, merged_len=n_chunks * ch
+            cfg, B, S, dtype, ring_len=ch, merged_pages=n_chunks
         )
     else:
         cache = init_cache(cfg, B, S, dtype, ring_len=n_chunks * ch)
     r = forward(
         params, cfg, ids, mask, positions,
         cache=cache, steer=steer_prompt, use_cache=True, logits_mode="last",
-        is_prefill=True,
+        is_prefill=True, sp_mesh=sp_mesh,
     )
     return _sample_and_decode(
         params, cfg, r.cache, r.logits, steer_decode, spec, true_len,
@@ -320,19 +325,20 @@ def generate_tokens_prefix(
     # now live in the main slots; decode starts from an all-invalid chunk
     # ring (+ merged buffer, unless the fused kernel path is active — it
     # needs the whole generation in the chunk ring).
-    RD = n_chunks * ch
-    RC = ch if _use_merged(cfg) else RD
-    RM = RD if _use_merged(cfg) else 0
+    RC = ch if _use_merged(cfg) else n_chunks * ch
+    PM = n_chunks if _use_merged(cfg) else 0
+    kvh_kd = cache.rk.shape[3:]
+    kvh_vd = cache.rv.shape[3:]
     cache = cache._replace(
-        rk=jnp.zeros((L, RC, B) + cache.rk.shape[3:], cache.rk.dtype),
-        rv=jnp.zeros((L, RC, B) + cache.rv.shape[3:], cache.rv.dtype),
+        rk=jnp.zeros((L, RC, B) + kvh_kd, cache.rk.dtype),
+        rv=jnp.zeros((L, RC, B) + kvh_vd, cache.rv.dtype),
         rpos=jnp.zeros((B, RC), jnp.int32),
         rvalid=jnp.zeros((B, RC), jnp.bool_),
         rlen=jnp.int32(0),
-        mk=jnp.zeros((L, RM, B) + cache.mk.shape[3:], cache.mk.dtype),
-        mv=jnp.zeros((L, RM, B) + cache.mv.shape[3:], cache.mv.dtype),
-        mpos=jnp.zeros((B, RM), jnp.int32),
-        mvalid=jnp.zeros((B, RM), jnp.bool_),
+        mk=jnp.zeros((L, PM, RC, B) + kvh_kd, cache.mk.dtype),
+        mv=jnp.zeros((L, PM, RC, B) + kvh_vd, cache.mv.dtype),
+        mpos=jnp.zeros((B, PM * RC), jnp.int32),
+        mvalid=jnp.zeros((B, PM * RC), jnp.bool_),
         mlen=jnp.int32(0),
     )
     true_len = P0 + suffix_mask.sum(axis=1).astype(jnp.int32)

@@ -68,6 +68,15 @@ class ModelRunner:
         self._calls = 0
         self.n_layers = cfg.n_layers
         self.hf_path = model_name
+        # Sequence parallelism: with a seq mesh axis > 1, S>1 chunks attend
+        # via ring attention (ops/ring.py) and the shared-prefix split is
+        # disabled (its suffix pass runs the cached-attention branch, which
+        # is not sequence-sharded).
+        self.sp_mesh = None
+        if mesh is not None:
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            if sizes.get("seq", 1) > 1:
+                self.sp_mesh = mesh
 
     # -- helpers ------------------------------------------------------------
 
@@ -215,6 +224,7 @@ class ModelRunner:
             r = forward(
                 self.params, self.cfg, ids, mask, make_positions(mask),
                 capture_pos=jnp.asarray(cap), capture=True, logits_mode="none",
+                sp_mesh=self.sp_mesh,
             )
             outs.append(np.asarray(r.captured, np.float32)[:, :B, :])
         return np.concatenate(outs, axis=1)
@@ -260,12 +270,15 @@ class ModelRunner:
         # prefix and nothing steers inside it, the prefix prefills ONCE at
         # batch 1 (generate_tokens_prefix) — the sweep's 4-turn preamble is
         # ~85% of each prompt, so this removes most prefill FLOPs.
-        L0 = self._prefix_split(
-            rows,
-            np.float32(0.0) if steering_vectors is None
-            else np.asarray(strength, np.float32),
-            steering_start_positions,
-        )
+        if self.sp_mesh is not None:
+            L0 = 0
+        else:
+            L0 = self._prefix_split(
+                rows,
+                np.float32(0.0) if steering_vectors is None
+                else np.asarray(strength, np.float32),
+                steering_start_positions,
+            )
         if L0:
             ids, mask, lens, B = self._prep_rows([r[L0:] for r in rows])
         else:
@@ -330,7 +343,7 @@ class ModelRunner:
         else:
             tokens = generate_tokens(
                 self.params, self.cfg, ids, mask, spec,
-                max_new_tokens=max_new_tokens,
+                max_new_tokens=max_new_tokens, sp_mesh=self.sp_mesh,
             )
         tokens = np.asarray(tokens)
         if debug:

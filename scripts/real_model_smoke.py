@@ -1,24 +1,35 @@
-"""Real-checkpoint smoke: download a small model, run 1 concept x 1 cell,
-and sanity-check that the steered responses are coherent text.
+"""Real-checkpoint smoke + published-number parity runs.
 
-This is the BASELINE.json configs[0] preparation recipe (VERDICT r3 item 5):
-every correctness claim in CI rests on tiny random-init parity models, so the
-moment a real checkpoint is reachable this script closes the loop end to end:
+Every correctness claim in CI rests on tiny random-init parity models; the
+moment a real checkpoint is reachable this script closes the loop end to end
+(BASELINE.json configs[0]; VERDICT r3 #5 / r4 #5).
+
+Smoke (1 concept x 1 cell + coherence heuristics):
 
     # with network + HF token (downloads ~2.5 GB):
     python scripts/real_model_smoke.py --model meta-llama/Llama-3.2-1B-Instruct
-
     # with a checkpoint already on disk:
     python scripts/real_model_smoke.py --model /path/to/llama-3.2-1b
 
-Exit code 0 means: the checkpoint loaded through the streaming loader, the
-sweep produced a results.json for the cell, and the responses pass the
-coherence heuristics below (mostly-printable text with real words — a wrong
-rope convention, bad dequant, or broken steering produces byte soup or empty
-strings, which this catches).
+Parity (reproduce a PUBLISHED cell, reference
+results/example_transcripts.txt:48-51 etc.): runs the model's best
+configuration with the paper protocol (50 concepts x 30 trials x 3 trial
+types, temp 1.0, 100 max tokens) and prints the three headline metrics next
+to the published values with binomial sampling bands:
 
-``tests/test_real_model.py`` runs the same check under pytest, skipped unless
-``IAT_REAL_CKPT`` points at a local checkpoint directory.
+    # the flagship published cell (llama_8b, L0.80 S1.0):
+    OPENAI_API_KEY=... python scripts/real_model_smoke.py \\
+        --parity llama_8b --model /path/to/Llama-3.1-8B-Instruct
+
+    # no API key: --judge-backend on-device (co-resident grader; absolute
+    # values shift with the judge — SURVEY §7.4.6) or none (keyword only).
+
+Exit code 0 means: the checkpoint loaded through the streaming loader, the
+sweep produced results.json, and (smoke) responses pass the coherence
+heuristics / (parity) judge metrics landed inside the sampling bands.
+
+``tests/test_real_model.py`` runs the smoke check under pytest, skipped
+unless ``IAT_REAL_CKPT`` points at a local checkpoint directory.
 """
 
 from __future__ import annotations
@@ -64,16 +75,121 @@ def coherence_report(responses: list[str]) -> tuple[bool, list[str]]:
     return not problems, problems
 
 
+# Published per-model best cells + headline metrics (reference
+# results/example_transcripts.txt; SURVEY.md §6 table). Values are percents.
+PUBLISHED = {
+    "llama_8b": dict(lf=0.80, s=1.0, det=44.7, fpr=85.2, intro=44.8),
+    "llama_70b": dict(lf=0.50, s=2.0, det=50.9, fpr=51.3, intro=30.3),
+    "qwen3_235b": dict(lf=0.80, s=4.0, det=71.1, fpr=0.0, intro=26.3),
+    "gemma3_27b": dict(lf=0.70, s=4.0, det=61.9, fpr=5.5, intro=22.7),
+    "llama_405b": dict(lf=0.40, s=2.0, det=54.5, fpr=6.4, intro=11.3),
+    "gemma2_9b": dict(lf=0.50, s=4.0, det=60.9, fpr=0.0, intro=7.1),
+    "qwen_14b": dict(lf=0.70, s=2.0, det=54.6, fpr=1.1, intro=3.5),
+    "gemma2_27b": dict(lf=0.50, s=4.0, det=55.9, fpr=0.1, intro=3.1),
+    "qwen_7b": dict(lf=0.50, s=8.0, det=58.2, fpr=0.3, intro=2.7),
+    "qwen_72b": dict(lf=0.60, s=8.0, det=56.4, fpr=0.0, intro=1.3),
+    "qwen_32b": dict(lf=0.70, s=4.0, det=61.1, fpr=0.1, intro=1.1),
+    "gemma2_2b": dict(lf=0.40, s=8.0, det=50.3, fpr=2.5, intro=0.7),
+}
+
+
+def run_parity(args) -> int:
+    """One published cell, full paper protocol, metric comparison."""
+    import math
+    import os
+
+    pub = PUBLISHED[args.parity]
+    ckpt = resolve_checkpoint(args.model)
+    judge_backend = args.judge_backend
+    if judge_backend is None:
+        judge_backend = "openai" if os.environ.get("OPENAI_API_KEY") else "none"
+    print(f"parity cell: {args.parity} L{pub['lf']:.2f} S{pub['s']} "
+          f"judge={judge_backend}  checkpoint={ckpt}")
+
+    from introspective_awareness_tpu.cli.sweep import main as sweep_main
+
+    argv = [
+        "--models", str(ckpt),
+        "--layer-fraction", f"{pub['lf']}",
+        "--strength", f"{pub['s']}",
+        # concepts / n-trials / temperature / max-tokens / batch default to
+        # the paper protocol (cli/args.py)
+        "--output-dir", args.output_dir,
+        "--judge-backend", judge_backend,
+        "--overwrite",
+    ]
+    if judge_backend == "on-device":
+        argv += ["--judge-model", args.judge_model or str(ckpt)]
+    rc = sweep_main(argv)
+    if rc != 0:
+        print(f"sweep failed (rc={rc})")
+        return rc
+
+    from introspective_awareness_tpu.metrics import config_dir
+
+    cell = config_dir(args.output_dir, str(ckpt), pub["lf"], pub["s"])
+    m = json.loads((cell / "results.json").read_text())["metrics"]
+    rows = [
+        ("detection accuracy", m.get("detection_accuracy"), pub["det"]),
+        ("false positive rate", m.get("detection_false_alarm_rate"), pub["fpr"]),
+        ("introspection rate",
+         m.get("combined_detection_and_identification_rate"), pub["intro"]),
+    ]
+    # ~2-sigma binomial band at n = 50 concepts x 30 trials = 1500 per type.
+    n = m.get("n_injection") or 1500
+    ok = True
+    print(f"\n{'metric':24s} {'ours':>8s} {'published':>10s} {'band':>8s}")
+    for name, ours, published in rows:
+        if ours is None:
+            print(f"{name:24s} {'n/a':>8s} {published:9.1f}%   (judge off)")
+            continue
+        ours_pct = 100.0 * ours
+        p = published / 100.0
+        band = 200.0 * math.sqrt(max(p * (1 - p), 1e-4) / n)
+        inside = abs(ours_pct - published) <= band + 5.0  # +5pp judge drift
+        ok &= inside
+        print(f"{name:24s} {ours_pct:7.1f}% {published:9.1f}% "
+              f"±{band:5.1f}pp {'ok' if inside else 'OUTSIDE'}")
+    if judge_backend != "openai":
+        print("\nnote: published numbers used the OpenAI gpt-4.1-nano judge; "
+              "other judges shift absolute values (bands are advisory).")
+        return 0
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--model", default="meta-llama/Llama-3.2-1B-Instruct")
+    ap.add_argument("--model", default=None,
+                    help="checkpoint dir or HF repo (smoke default: "
+                         "meta-llama/Llama-3.2-1B-Instruct; REQUIRED with "
+                         "--parity so the published cell can't silently run "
+                         "against the wrong model)")
     ap.add_argument("--concept", default="ocean")
     ap.add_argument("--output-dir", default="results/real_smoke")
     ap.add_argument("--layer-fraction", type=float, default=0.5)
     ap.add_argument("--strength", type=float, default=8.0)
     ap.add_argument("--max-tokens", type=int, default=60)
     ap.add_argument("--n-trials", type=int, default=2)
+    ap.add_argument("--parity", choices=sorted(PUBLISHED),
+                    help="Reproduce this model's PUBLISHED best cell with the "
+                         "full paper protocol and compare headline metrics")
+    ap.add_argument("--judge-backend", choices=["openai", "on-device", "none"],
+                    default=None,
+                    help="Parity judge (default: openai if OPENAI_API_KEY is "
+                         "set, else none)")
+    ap.add_argument("--judge-model", default=None,
+                    help="on-device judge checkpoint (default: the subject)")
     args = ap.parse_args(argv)
+    if args.parity:
+        if args.model is None:
+            ap.error(
+                f"--parity {args.parity} needs an explicit --model pointing "
+                f"at a {args.parity} checkpoint (the full paper protocol is "
+                "hours of compute — refusing to guess the subject)"
+            )
+        return run_parity(args)
+    if args.model is None:
+        args.model = "meta-llama/Llama-3.2-1B-Instruct"
 
     ckpt = resolve_checkpoint(args.model)
     print(f"checkpoint: {ckpt}")

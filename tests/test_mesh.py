@@ -150,3 +150,41 @@ def test_with_sharding_constraint_under_jit(mesh8):
     # batch dim split 2-way over 'data'
     assert {s.data.shape for s in out.addressable_shards} == {(4, 16)}
     np.testing.assert_array_equal(np.asarray(out), x * 2.0)
+
+
+def test_moe_dispatch_ep_sharded_matches_unsharded():
+    """The sort/segment dispatch path composes with expert-parallel
+    sharding: logits on an ep=2 mesh equal the single-device run."""
+    import dataclasses
+
+    import numpy as np
+
+    from introspective_awareness_tpu.models.config import tiny_config
+    from introspective_awareness_tpu.models.transformer import (
+        forward,
+        init_params,
+        make_positions,
+        param_logical_axes,
+    )
+
+    cfg = dataclasses.replace(
+        tiny_config(n_experts=4, n_experts_per_tok=2, moe_mlp_hidden=32),
+        moe_dispatch="topk", moe_capacity_factor=2.0,
+    )
+    params = init_params(cfg, jax.random.key(3))
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 12)), jnp.int32
+    )
+    mask = jnp.ones((2, 12), jnp.int32)
+    plain = np.asarray(
+        forward(params, cfg, ids, mask, make_positions(mask),
+                logits_mode="all").logits
+    )
+
+    mesh = build_mesh(MeshConfig(dp=2, tp=2, ep=2))
+    sharded = shard_params(params, param_logical_axes(cfg), mesh, ShardingRules())
+    ep = np.asarray(
+        forward(sharded, cfg, ids, mask, make_positions(mask),
+                logits_mode="all").logits
+    )
+    np.testing.assert_allclose(plain, ep, rtol=2e-4, atol=2e-4)

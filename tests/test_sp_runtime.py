@@ -1,0 +1,89 @@
+"""Sequence parallelism plumbed into the RUNTIME (VERDICT r4 #3): steered
+generation and activation extraction on an sp>1 mesh run ring-attention
+prefill end-to-end and match the single-device results.
+
+Uses the 8-device CPU mesh from conftest. Greedy decode on the tiny model is
+token-identical across shardings in practice; activations compare with a
+float tolerance (ring reorders the softmax reductions).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from introspective_awareness_tpu.models.config import tiny_config
+from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+from introspective_awareness_tpu.models.transformer import (
+    init_params,
+    param_logical_axes,
+)
+from introspective_awareness_tpu.parallel import (
+    MeshConfig,
+    ShardingRules,
+    build_mesh,
+)
+from introspective_awareness_tpu.parallel import sharding as shax
+from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+
+@pytest.fixture(scope="module")
+def runners():
+    cfg = tiny_config(n_layers=4)
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    tok = ByteTokenizer()
+    plain = ModelRunner(params, cfg, tok, model_name="tiny")
+
+    mesh = build_mesh(MeshConfig(dp=1, tp=1, ep=1, sp=8))
+    rules = ShardingRules()
+    sharded = shax.shard_params(params, param_logical_axes(cfg), mesh, rules)
+    sp = ModelRunner(
+        sharded, cfg, tok, model_name="tiny-sp8", mesh=mesh, rules=rules
+    )
+    assert sp.sp_mesh is not None, "seq axis must activate the ring path"
+    return plain, sp
+
+
+def _prompts():
+    base = (
+        "I am an interpretability researcher studying transformer-based "
+        "language models. On each trial I either inject a thought or not. "
+    )
+    return [
+        base + f"Trial {i + 1}: Do you detect an injected thought? "
+        "If so, what is it about?" for i in range(3)
+    ]
+
+
+def test_sp_generation_token_identity(runners):
+    plain, sp = runners
+    rng = np.random.default_rng(0)
+    cfg = plain.cfg
+    vecs = rng.normal(size=(3, cfg.hidden_size)).astype(np.float32) * 3.0
+
+    kw = dict(
+        layer_idx=2, steering_vectors=list(vecs), strength=4.0,
+        max_new_tokens=24, temperature=0.0,
+        steering_start_positions=[40, 45, 50], seed=7,
+    )
+    a = plain.generate_batch_with_multi_steering(_prompts(), **kw)
+    b = sp.generate_batch_with_multi_steering(_prompts(), **kw)
+    assert a == b
+
+
+def test_sp_extraction_matches(runners):
+    plain, sp = runners
+    acts_a = plain.extract_activations(_prompts(), layer_idx=2)
+    acts_b = sp.extract_activations(_prompts(), layer_idx=2)
+    np.testing.assert_allclose(acts_a, acts_b, rtol=2e-4, atol=2e-4)
+
+
+def test_sp_long_context_smoke(runners):
+    """A long (multi-shard, unaligned) prompt generates identically with
+    sequence-parallel prefill — the long-context grader use case."""
+    plain, sp = runners
+    long_prompt = "The quick brown fox jumps over the lazy dog. " * 40  # ~1.8k chars
+    a = plain.generate_batch([long_prompt], max_new_tokens=16, seed=3)
+    b = sp.generate_batch([long_prompt], max_new_tokens=16, seed=3)
+    assert a == b

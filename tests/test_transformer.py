@@ -347,3 +347,54 @@ def test_sliding_window_restricts_attention():
     la = forward(params, cfg_w, jnp.asarray(ids), mask, pos).logits
     lb = forward(params, cfg_w, jnp.asarray(ids2), mask, pos).logits
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=1e-5)
+
+
+def test_moe_dispatch_matches_dense():
+    """Sort/segment top-k dispatch == dense-combine when capacity admits
+    every assignment (capacity_factor >= E/K), for both MoE styles."""
+    import dataclasses
+
+    ids_key, p_key = jax.random.key(20), jax.random.key(21)
+    for style_kw in (
+        dict(),  # softmax_topk (Mixtral/Qwen-MoE)
+        dict(moe_style="deepseek_v3", n_shared_experts=1,
+             routed_scaling_factor=2.5, n_group=2, topk_group=1,
+             moe_topk_method="noaux_tc"),
+    ):
+        cfg_d = tiny_config(
+            n_experts=4, n_experts_per_tok=2, moe_mlp_hidden=32, **style_kw
+        )
+        params = init_params(cfg_d, p_key)
+        ids = _ids(ids_key, 2, 10, cfg_d.vocab_size)
+        mask = jnp.ones((2, 10), jnp.int32)
+        dense = np.asarray(
+            forward(params, cfg_d, ids, mask, make_positions(mask),
+                    logits_mode="all").logits
+        )
+        cfg_t = dataclasses.replace(
+            cfg_d, moe_dispatch="topk", moe_capacity_factor=2.0
+        )  # cf >= E/K = 2 -> no drops
+        disp = np.asarray(
+            forward(params, cfg_t, ids, mask, make_positions(mask),
+                    logits_mode="all").logits
+        )
+        np.testing.assert_allclose(dense, disp, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_dispatch_drops_overflow():
+    """With capacity_factor << E/K some assignments drop — outputs stay
+    finite and differ from dense (documents Switch/GShard drop semantics)."""
+    import dataclasses
+
+    cfg_d = tiny_config(n_experts=4, n_experts_per_tok=2, moe_mlp_hidden=32)
+    cfg_t = dataclasses.replace(
+        cfg_d, moe_dispatch="topk", moe_capacity_factor=0.25
+    )
+    params = init_params(cfg_d, jax.random.key(22))
+    ids = _ids(jax.random.key(23), 2, 16, cfg_d.vocab_size)
+    mask = jnp.ones((2, 16), jnp.int32)
+    out = np.asarray(
+        forward(params, cfg_t, ids, mask, make_positions(mask),
+                logits_mode="all").logits
+    )
+    assert np.isfinite(out).all()
