@@ -159,6 +159,10 @@ class OnDeviceJudgeClient:
     # decode loop stops there (GenSpec.stop_seqs). parse_yes_no reads
     # "Answer: X" wherever it appears, so truncating after it is lossless.
     STOP_STRINGS = ("Answer: YES", "Answer: NO")
+    # criteria.render("prefix-cached"): the whole (verbatim) criteria text
+    # becomes a shared token prefix, so the runner's shared-prefix KV cache
+    # prefills it once per grading batch instead of once per row.
+    preferred_prompt_order = "prefix-cached"
 
     def __init__(self, runner, max_tokens: int = 500, chunk_size: int = 256):
         self.runner = runner

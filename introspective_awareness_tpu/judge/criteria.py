@@ -21,6 +21,41 @@ class EvaluationCriteria:
     description: str
     grading_prompt: str
 
+    def render(self, order: str = "reference", **fields) -> str:
+        """Format the grading prompt.
+
+        ``order="reference"`` is the spec: fields interpolate in place
+        (reference eval_utils.py formatting; published numbers used this).
+
+        ``order="prefix-cached"`` keeps the criteria TEXT verbatim but moves
+        the per-trial fields to the END, so every grading prompt of a batch
+        shares the whole criteria as a token prefix — the on-device judge's
+        shared-prefix KV cache then prefills it once per batch instead of
+        per row (the criteria are ~4x the length of the graded exchange).
+        The judge's absolute operating point may shift slightly with the
+        reordering, so it is opt-in and recorded by the client name.
+        """
+        if order == "reference":
+            return self.grading_prompt.format(**fields)
+        if order != "prefix-cached":
+            raise ValueError(f"unknown prompt order {order!r}")
+        section = {
+            "prompt": "QUESTION the AI was asked",
+            "response": "AI RESPONSE to grade",
+            "word": "TARGET WORD",
+        }
+        head = self.grading_prompt.format(
+            **{k: f"(see the {section.get(k, k.upper())} section at the end)"
+               for k in fields}
+        )
+        tail = "\n\n".join(
+            f"{section.get(k, k.upper())}:\n{v}" for k, v in fields.items()
+        )
+        return (
+            f"{head}\n\n{tail}\n\n"
+            "Now give your final answer in the exact format specified above."
+        )
+
 
 # Legacy criteria (reference eval_utils.py:35-127) -------------------------
 

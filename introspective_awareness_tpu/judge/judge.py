@@ -35,6 +35,7 @@ class LLMJudge:
         temperature: float = 0.0,
         max_concurrent: int = 100,
         client: Optional[JudgeClient] = None,
+        prompt_order: str = "auto",
     ):
         if client is None:
             client = OpenAIJudgeClient(
@@ -46,6 +47,12 @@ class LLMJudge:
             )
         self.client = client
         self.model_name = getattr(client, "model_name", model)
+        # "auto": the client picks — the on-device grader prefers
+        # prefix-cached criteria rendering (criteria.render), API judges the
+        # reference order the published numbers used.
+        if prompt_order == "auto":
+            prompt_order = getattr(client, "preferred_prompt_order", "reference")
+        self.prompt_order = prompt_order
 
     # -- single-response criteria (reference eval_utils.py:433-668) ---------
 
@@ -148,8 +155,8 @@ class LLMJudge:
         start_time = time.time()
 
         claims_prompts = [
-            CLAIMS_DETECTION_CRITERIA.grading_prompt.format(
-                prompt=orig, response=result["response"]
+            CLAIMS_DETECTION_CRITERIA.render(
+                self.prompt_order, prompt=orig, response=result["response"]
             )
             for result, orig in zip(results, original_prompts)
         ]
@@ -167,8 +174,9 @@ class LLMJudge:
         for i, (result, orig) in enumerate(zip(results, original_prompts)):
             if claims_results[i]["claims_detection"]:
                 ident_prompts.append(
-                    CORRECT_CONCEPT_IDENTIFICATION_CRITERIA.grading_prompt.format(
-                        prompt=orig, response=result["response"], word=result["concept"]
+                    CORRECT_CONCEPT_IDENTIFICATION_CRITERIA.render(
+                        self.prompt_order, prompt=orig,
+                        response=result["response"], word=result["concept"],
                     )
                 )
                 ident_indices.append(i)

@@ -1,0 +1,82 @@
+"""Standalone judge-row measurement (the bench's int8+fp8kv+judge config):
+subject generates a batch, co-resident grader runs stage-1 claims grading.
+Prints graded evals/s/chip and the phase split."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from introspective_awareness_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache()
+
+import jax
+import jax.numpy as jnp
+import dataclasses
+
+from bench import _build_workload
+from introspective_awareness_tpu.judge import LLMJudge, OnDeviceJudgeClient
+from introspective_awareness_tpu.judge.judge import reconstruct_trial_prompts
+from introspective_awareness_tpu.models.config import ModelConfig
+from introspective_awareness_tpu.models.quant import quantize_params
+from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+from introspective_awareness_tpu.models.transformer import init_params
+from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+
+def main() -> None:
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 192
+    max_new = 100
+    cfg = ModelConfig(
+        vocab_size=128256, hidden_size=2048, n_layers=16, n_heads=32,
+        n_kv_heads=8, head_dim=64, mlp_hidden=8192, rope_theta=500000.0,
+        tie_embeddings=True, attn_impl="flash",
+    )
+    cfg8 = dataclasses.replace(cfg, kv_cache_dtype="fp8")
+    init = jax.jit(init_params, static_argnames=("cfg", "dtype"))
+    qp = quantize_params(init(cfg, jax.random.key(0), dtype=jnp.bfloat16),
+                         bits=8, dtype=jnp.bfloat16, include_embed=True)
+    gp = quantize_params(init(cfg, jax.random.key(1), dtype=jnp.bfloat16),
+                         bits=8, dtype=jnp.bfloat16, include_embed=True)
+    tok = ByteTokenizer()
+    subject = ModelRunner(qp, cfg8, tok, model_name="subject")
+    grader = ModelRunner(gp, cfg8, tok, model_name="grader")
+
+    judge = LLMJudge(
+        client=OnDeviceJudgeClient(grader, max_tokens=48, chunk_size=192)
+    )
+    prompts, vecs, starts = _build_workload(cfg, tok, b)
+    tj = [0.0]
+
+    def cycle(seed):
+        responses = subject.generate_batch_with_multi_steering(
+            prompts, layer_idx=int(cfg.n_layers * 0.6),
+            steering_vectors=list(vecs), strength=4.0,
+            max_new_tokens=max_new, temperature=1.0,
+            steering_start_positions=starts, seed=seed,
+        )
+        rs = [{"concept": "bench", "response": r, "trial": i + 1,
+               "trial_type": "injection"} for i, r in enumerate(responses)]
+        t0 = time.perf_counter()
+        out = judge.evaluate_batch(rs, reconstruct_trial_prompts(rs))
+        tj[0] += time.perf_counter() - t0
+        return out
+
+    t0 = time.perf_counter()
+    cycle(0)
+    print(f"warmup {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    tj[0] = 0.0
+    t0 = time.perf_counter()
+    for i in range(2):
+        cycle(i + 1)
+    dt = time.perf_counter() - t0
+    print(f"batch={b}: {2 * b / dt:.1f} graded evals/s/chip "
+          f"(grading {tj[0]:.1f}s of {dt:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()
