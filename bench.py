@@ -76,21 +76,36 @@ def _build_workload(cfg, tok, batch: int):
     return prompts, vecs, starts
 
 
-def _token_stats(runner, cfg, prompts, vecs, starts, max_new: int) -> dict:
-    """Generate once at the token level and return id statistics.
+def _token_stats(runner, cfg, prompts, vecs, starts, max_new: int,
+                 ledger=None) -> tuple[dict, dict]:
+    """Generate once at the token level; return (id statistics, preflight).
 
     The ByteTokenizer cannot decode ids >= 256, so a decoded ``sample:``
     string proves nothing on the 128k-vocab bench model. Token-id statistics
     do: real sampling at temp 1.0 over random-init logits must produce mostly
     non-pad, diverse ids; all-pad output would mean generation is broken.
+
+    The generate executable is AOT-compiled here (lower -> compile), which
+    exposes ``memory_analysis()`` BEFORE anything runs: the HBM preflight
+    verdict that would have caught the round-5 RESOURCE_EXHAUSTED pre-launch.
+    Separate ``prefill`` and ``decode`` ledger spans bracket a prefill-only
+    forward and the full compiled generation, so the bench doc carries
+    per-phase tok/s.
     """
+    import jax
     import jax.numpy as jnp
 
+    from introspective_awareness_tpu import obs
+    from introspective_awareness_tpu.models.transformer import (
+        forward,
+        make_positions,
+    )
     from introspective_awareness_tpu.runtime.generate import (
         GenSpec,
         generate_tokens,
     )
 
+    ledger = ledger if ledger is not None else obs.NullLedger()
     ids, mask, lens, B = runner._prep(prompts)
     S = ids.shape[1]
     starts_padded = np.asarray(S - lens + np.asarray(starts), np.int32)
@@ -104,11 +119,31 @@ def _token_stats(runner, cfg, prompts, vecs, starts, max_new: int) -> dict:
         eos_ids=jnp.asarray(list(runner.tokenizer.eos_ids), jnp.int32),
         pad_id=jnp.int32(runner.tokenizer.pad_id),
     )
-    tokens = np.asarray(
-        generate_tokens(
-            runner.params, cfg, ids, mask, spec, max_new_tokens=max_new
+
+    # Prefill-only phase span (the decode span below re-runs prefill inside
+    # the fused generate program; this isolates prompt-processing tok/s).
+    with ledger.span("prefill", batch=B, seq=int(S)) as sp:
+        r = forward(
+            runner.params, cfg, ids, mask, make_positions(mask),
+            use_cache=False, logits_mode="last",
         )
-    )[:B]
+        sp.watch(r.logits)
+        sp.add_tokens(int(np.asarray(mask).sum()))
+
+    compiled = generate_tokens.lower(
+        runner.params, cfg, ids, mask, spec,
+        max_new_tokens=max_new, sp_mesh=None,
+    ).compile()
+    report = obs.preflight(
+        compiled, label=f"generate_tokens[b{ids.shape[0]},s{S}]",
+        budget_frac=0.9, enforce=False, ledger=ledger, verbose=True,
+    )
+
+    with ledger.span("decode", batch=B, seq=int(S),
+                     max_new_tokens=max_new) as sp:
+        tokens = sp.watch(compiled(runner.params, ids, mask, spec))
+        sp.add_tokens(ids.shape[0] * max_new)
+    tokens = np.asarray(tokens)[:B]
     pad = int(runner.tokenizer.pad_id)
     nonpad = tokens != pad
     first = tokens[:, 0]
@@ -121,7 +156,7 @@ def _token_stats(runner, cfg, prompts, vecs, starts, max_new: int) -> dict:
         "distinct_rows_by_first_token": int(len(np.unique(first))),
         "prompt_len": int(S),
         "n_generated_tokens": int(nonpad.sum()),
-    }
+    }, report.as_dict()
 
 
 def _timed_config(runner, cfg, tok, batch, max_new, iters, label) -> dict:
@@ -186,11 +221,19 @@ def _hbm_model(runner, cfg, batch, prompt_len, max_new) -> float:
 def main() -> None:
     import jax
 
+    from introspective_awareness_tpu import obs
     from introspective_awareness_tpu.utils import enable_compilation_cache
 
     # Warm restarts skip the ~7 config compiles (~4 min of the bench's
     # wall-clock); cold runs are unaffected beyond cache writes.
     enable_compilation_cache()
+    acct = obs.CompileAccounting.install()
+    compile_before = acct.snapshot()
+    # In-memory ledger: phase spans land in the final JSON document (set
+    # IAT_BENCH_LEDGER to also stream the raw JSONL to a file).
+    import os
+
+    ledger = obs.RunLedger(path=os.environ.get("IAT_BENCH_LEDGER"))
 
     from introspective_awareness_tpu.models.config import ModelConfig, tiny_config
     from introspective_awareness_tpu.models.quant import quantize_params
@@ -233,19 +276,24 @@ def main() -> None:
     # One compiled program for the whole init — eager per-tensor init pays a
     # host<->device dispatch round-trip per parameter, which dominated r03's
     # bench startup (50s for 1.24B params).
-    init = jax.jit(init_params, static_argnames=("cfg", "dtype"))
-    params = init(cfg, jax.random.key(0), dtype=dtype)
-    jax.block_until_ready(params)
+    with ledger.span("load", model="bench-llama1b-shape"):
+        init = jax.jit(init_params, static_argnames=("cfg", "dtype"))
+        params = init(cfg, jax.random.key(0), dtype=dtype)
+        jax.block_until_ready(params)
     log(f"init {sum(x.size for x in jax.tree.leaves(params))/1e9:.2f}B params "
         f"in {time.perf_counter()-t0:.1f}s")
 
-    runner = ModelRunner(params, cfg, tok, model_name="bench-llama1b-shape")
+    runner = ModelRunner(
+        params, cfg, tok, model_name="bench-llama1b-shape", ledger=ledger
+    )
 
     # Honest output check: token-id statistics from one token-level run
     # (decoded text can't prove anything — the byte tokenizer drops ids>=256).
     stats_batch = min(batches[0], 32)
     prompts, vecs, starts = _build_workload(cfg, tok, stats_batch)
-    stats = _token_stats(runner, cfg, prompts, vecs, starts, max_new)
+    stats, preflight_verdict = _token_stats(
+        runner, cfg, prompts, vecs, starts, max_new, ledger=ledger
+    )
     log(f"token stats: {stats}")
     # A random-init model under strength-4 steering legitimately emits
     # near-constant ids per row (the injected vector dominates the residual
@@ -275,7 +323,8 @@ def main() -> None:
         # of a decode step (0.5 GB bf16 at Llama-3 vocab).
         q_params = quantize_params(params, bits=8, dtype=dtype, include_embed=True)
         q_runner = ModelRunner(
-            q_params, cfg, tok, model_name="bench-llama1b-int8"
+            q_params, cfg, tok, model_name="bench-llama1b-int8",
+            ledger=ledger,
         )
         results.append(
             _timed_config(
@@ -286,7 +335,8 @@ def main() -> None:
         # ---- + fp8 KV cache: halves the dominant decode HBM stream ---------
         cfg8 = dataclasses.replace(cfg, kv_cache_dtype="fp8")
         kv_runner = ModelRunner(
-            q_params, cfg8, tok, model_name="bench-llama1b-int8-fp8kv"
+            q_params, cfg8, tok, model_name="bench-llama1b-int8-fp8kv",
+            ledger=ledger,
         )
         results.append(
             _timed_config(
@@ -313,7 +363,8 @@ def main() -> None:
             include_embed=True,
         )
         grader = ModelRunner(
-            grader_params, cfg8, tok, model_name="bench-grader-1b-int8-fp8kv"
+            grader_params, cfg8, tok, model_name="bench-grader-1b-int8-fp8kv",
+            ledger=ledger,
         )
 
         # The grader runs the FULL verbatim criteria with the prefix-cached
@@ -325,6 +376,7 @@ def main() -> None:
         judge = LLMJudge(
             client=OnDeviceJudgeClient(grader, max_tokens=48, chunk_size=96)
         )
+        judge.ledger = ledger
         b = min(192, best_bf16["batch"])
         prompts, vecs, starts = _build_workload(cfg, tok, b)
         judge_phase = [0.0]
@@ -417,6 +469,23 @@ def main() -> None:
             f"({100 * hbm_util:.0f}% of {peak:.0f} GB/s peak)"
         )
 
+    # Live per-device HBM watermark (None off-TPU: CPU backends don't
+    # report memory_stats).
+    hbm_devices = []
+    for d in jax.devices():
+        ms = d.memory_stats() or {}
+        hbm_devices.append({
+            "id": d.id,
+            "kind": d.device_kind,
+            "bytes_in_use": ms.get("bytes_in_use"),
+            "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
+            "bytes_limit": ms.get("bytes_limit"),
+        })
+
+    # ONE machine-parseable JSON document on stdout: headline metric +
+    # per-phase ledger spans (prefill/decode/load/judge with tok/s and
+    # evals/s/chip), the HBM preflight verdict, live HBM watermarks, and
+    # compile accounting. BENCH_*.json `parsed` is this object.
     print(json.dumps({
         "metric": "injected-thought evals/sec/chip",
         "value": round(best["evals_per_sec_chip"], 4),
@@ -430,7 +499,14 @@ def main() -> None:
             for r in results
         ],
         "token_stats": stats,
+        "phases": ledger.summary().get("phases", {}),
+        "hbm_preflight": preflight_verdict,
+        "hbm_devices": hbm_devices,
+        "compile_stats": acct.delta_since(compile_before),
+        "n_chips": n_chips,
+        "device_kind": jax.devices()[0].device_kind,
     }))
+    ledger.close()
 
 
 if __name__ == "__main__":

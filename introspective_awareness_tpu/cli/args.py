@@ -116,6 +116,17 @@ def build_parser() -> argparse.ArgumentParser:
                              "'off', or a directory path. Warm process "
                              "restarts (sweep resume after preemption) then "
                              "skip recompilation.")
+    parser.add_argument("--obs-ledger", type=str, default="auto",
+                        help="Structured run ledger (JSONL phase spans with "
+                             "wall/device time, tok/s, evals/s/chip): 'auto' "
+                             "writes <output-dir>/run_ledger.jsonl, 'off' "
+                             "disables, else an explicit path")
+    parser.add_argument("--hbm-budget-frac", type=float, default=None,
+                        help="HBM preflight gate: AOT-compile generate "
+                             "executables and fail fast if their "
+                             "memory_analysis() footprint exceeds this "
+                             "fraction of per-device HBM (e.g. 0.9), naming "
+                             "the largest temp buffers. Default off.")
     return parser
 
 

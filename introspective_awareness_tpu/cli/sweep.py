@@ -31,6 +31,7 @@ from introspective_awareness_tpu.metrics import (
     config_dir,
     results_to_csv,
     save_evaluation_results,
+    save_run_manifest,
     vector_path,
 )
 from introspective_awareness_tpu.judge.judge import reconstruct_trial_prompts
@@ -175,19 +176,24 @@ def load_subject(name: str, args, mesh, rules):
 def run_sweep(args, runner, judge, model_name: str) -> dict:
     """All (layer, strength) cells for one loaded model. Returns
     ``{(layer_frac, strength): {"results": ..., <metrics>}}`` for plotting."""
+    from introspective_awareness_tpu.obs import CompileAccounting
+
     out_base = Path(args.output_dir) / model_name.replace("/", "_")
     layer_fractions = list(args.layer_sweep)
     strengths = list(args.strength_sweep)
     timings: dict[str, float] = {}
+    ledger = runner.ledger
+    compile_before = CompileAccounting.install().snapshot()
 
     # ---- vectors for every swept layer, one capture pass ------------------
     t0 = time.perf_counter()
-    table = extract_concept_vectors_all_layers(
-        runner,
-        args.concepts,
-        get_baseline_words(args.n_baseline),
-        extraction_method=args.extraction_method,
-    )
+    with ledger.span("extract", model=model_name, what="concept_vectors"):
+        table = extract_concept_vectors_all_layers(
+            runner,
+            args.concepts,
+            get_baseline_words(args.n_baseline),
+            extraction_method=args.extraction_method,
+        )
     vectors_by_fraction = {
         lf: table[get_layer_at_fraction(runner.n_layers, lf)]
         for lf in layer_fractions
@@ -388,26 +394,38 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             # Back-compat alias for manifest consumers written against the
             # per-cell field name.
             timings["generation_cells_s"] = cell_times
-    _write_manifest(out_base, args, runner, timings)
+    _write_manifest(
+        out_base, args, runner, timings,
+        judge=judge, compile_before=compile_before,
+    )
     _write_summary(out_base, all_results, layer_fractions, strengths)
     return all_results
 
 
 def _cell_metrics(results, judge, args, lf, layer_idx, strength) -> dict:
     """Judge metrics with keyword fallback (reference :2064-2122)."""
+    from introspective_awareness_tpu.obs import NullLedger
+
+    ledger = getattr(args, "_ledger", None) or NullLedger()
     if judge is not None:
         try:
             evaluated = judge.evaluate_batch(
                 results, reconstruct_trial_prompts(results)
             )
             results[:] = evaluated
-            metrics = compute_detection_and_identification_metrics(evaluated)
+            with ledger.span("grade", evals=len(evaluated), cell=f"{lf}/{strength}"):
+                metrics = compute_detection_and_identification_metrics(evaluated)
             metrics["metrics_source"] = "judge"
+            # Grading-order provenance: a prefix-cached on-device judge
+            # reorders criteria fields for KV reuse; reference-parity runs
+            # must be distinguishable from reordered grading.
+            metrics["judge_prompt_order"] = judge.prompt_order
         except Exception as e:  # noqa: BLE001 - degrade, don't lose responses
             print(f"  judge failed ({e}); keyword metrics")
             metrics = _keyword_metrics(results)
     else:
-        metrics = _keyword_metrics(results)
+        with ledger.span("grade", evals=len(results), cell=f"{lf}/{strength}"):
+            metrics = _keyword_metrics(results)
     metrics.update({
         "layer_fraction": lf,
         "layer_idx": layer_idx,
@@ -473,8 +491,13 @@ def _write_cell_texts(results, metrics, cell_dir: Path, model_name: str) -> None
     (cell_dir / "summary.txt").write_text("\n".join(lines) + "\n")
 
 
-def _write_manifest(out_base: Path, args, runner, timings: dict) -> None:
+def _write_manifest(
+    out_base: Path, args, runner, timings: dict,
+    judge=None, compile_before: Optional[dict] = None,
+) -> None:
     import jax
+
+    from introspective_awareness_tpu.obs import CompileAccounting
 
     out_base.mkdir(parents=True, exist_ok=True)
     mesh = runner.mesh
@@ -493,9 +516,24 @@ def _write_manifest(out_base: Path, args, runner, timings: dict) -> None:
             else args.compilation_cache_dir
         ),
         "timings": timings,
+        # Observability enrichment: persistent-cache hits/misses and
+        # per-executable compile seconds for this model's sweep, the run
+        # ledger's per-phase aggregate, and the judge provenance
+        # (prompt_order distinguishes reference-parity from prefix-cached
+        # reordered grading).
+        "compile_stats": CompileAccounting.install().delta_since(compile_before),
+        "ledger": runner.ledger.summary(),
+        "ledger_path": getattr(runner.ledger, "path", None),
+        "hbm_budget_frac": getattr(args, "hbm_budget_frac", None),
+        "judge": (
+            None if judge is None else {
+                "backend": getattr(args, "judge_backend", None),
+                "model": getattr(args, "judge_model", None),
+                "prompt_order": judge.prompt_order,
+            }
+        ),
     }
-    with open(out_base / "run_manifest.json", "w") as f:
-        json.dump(manifest, f, indent=2)
+    save_run_manifest(manifest, out_base)
 
 
 def _write_summary(out_base, all_results, layer_fractions, strengths) -> None:
@@ -627,7 +665,33 @@ def main(argv: Optional[list[str]] = None) -> int:
         devices=devices,
     )
     rules = ShardingRules()
+
+    from introspective_awareness_tpu.obs import (
+        CompileAccounting,
+        NullLedger,
+        RunLedger,
+    )
+
+    # Compile accounting listens for the whole process (cache hits/misses +
+    # backend-compile seconds); the manifest records the per-model delta.
+    CompileAccounting.install()
+    if args.obs_ledger == "off":
+        ledger = NullLedger()
+    else:
+        ledger_path = (
+            str(Path(args.output_dir) / "run_ledger.jsonl")
+            if args.obs_ledger == "auto" else args.obs_ledger
+        )
+        ledger = RunLedger(
+            path=ledger_path,
+            n_chips=int(mesh.devices.size) if mesh is not None
+            else jax.device_count(),
+        )
+    args._ledger = ledger
+
     judge = _build_judge(args, mesh, rules)
+    if judge is not None:
+        judge.ledger = ledger
 
     for model_name in models:
         print(f"=== {model_name} ===")
@@ -657,7 +721,10 @@ def main(argv: Optional[list[str]] = None) -> int:
         else:
             from introspective_awareness_tpu.utils import profile_trace
 
-            runner = load_subject(model_name, args, mesh, rules)
+            with ledger.span("load", model=model_name):
+                runner = load_subject(model_name, args, mesh, rules)
+            runner.ledger = ledger
+            runner.hbm_budget_frac = args.hbm_budget_frac
             with profile_trace(args.profile_dir):
                 all_results = run_sweep(args, runner, judge, model_name)
             write_debug_dumps(out_base, runner, args, all_results)

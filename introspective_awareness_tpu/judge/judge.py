@@ -9,6 +9,7 @@ published metrics.
 
 from __future__ import annotations
 
+import sys
 import time
 from typing import Optional, Sequence
 
@@ -53,6 +54,9 @@ class LLMJudge:
         if prompt_order == "auto":
             prompt_order = getattr(client, "preferred_prompt_order", "reference")
         self.prompt_order = prompt_order
+        # Optional obs.RunLedger; the sweep attaches one so judge passes
+        # appear as "judge" phase spans with evals/s/chip.
+        self.ledger = None
 
     # -- single-response criteria (reference eval_utils.py:433-668) ---------
 
@@ -152,6 +156,18 @@ class LLMJudge:
         """Stage 1: claims-detection for all; stage 2: identification for
         claimers only (non-claimers auto-score 0). Adds ``evaluations`` to a
         copy of each result."""
+        from introspective_awareness_tpu.obs import NullLedger
+
+        ledger = self.ledger if self.ledger is not None else NullLedger()
+        with ledger.span(
+            "judge", evals=len(results), prompt_order=self.prompt_order,
+            judge_model=self.model_name,
+        ):
+            return self._evaluate_batch_inner(results, original_prompts)
+
+    def _evaluate_batch_inner(
+        self, results: Sequence[dict], original_prompts: Sequence[str]
+    ) -> list[dict]:
         start_time = time.time()
 
         claims_prompts = [
@@ -209,9 +225,11 @@ class LLMJudge:
 
         elapsed = time.time() - start_time
         if elapsed > 0:
+            # stderr: bench.py reserves stdout for its single JSON document.
             print(
                 f"  Judge: {len(results)} results in {elapsed:.1f}s "
-                f"({len(results) / elapsed:.1f} evals/sec)"
+                f"({len(results) / elapsed:.1f} evals/sec)",
+                file=sys.stderr,
             )
         return evaluated
 

@@ -14,8 +14,10 @@ from introspective_awareness_tpu.metrics.metrics import (
 from introspective_awareness_tpu.metrics.persistence import (
     config_dir,
     load_evaluation_results,
+    load_run_manifest,
     results_to_csv,
     save_evaluation_results,
+    save_run_manifest,
     vector_path,
 )
 
@@ -26,7 +28,9 @@ __all__ = [
     "identifies_concept",
     "config_dir",
     "load_evaluation_results",
+    "load_run_manifest",
     "results_to_csv",
     "save_evaluation_results",
+    "save_run_manifest",
     "vector_path",
 ]

@@ -68,6 +68,41 @@ def load_evaluation_results(load_path: Path | str) -> tuple[list[dict], dict]:
     return data["results"], data.get("metrics", {})
 
 
+def save_run_manifest(manifest: Mapping, out_base: Path | str) -> Path:
+    """<out_base>/run_manifest.json — the per-model observability artifact
+    (mesh, timings, compile stats, ledger phase aggregate, judge provenance).
+
+    Non-JSON leaves (numpy scalars, Paths) are coerced so enrichment sources
+    (obs summaries, arbitrary timings) can be dropped in without each caller
+    hand-sanitizing."""
+    out_base = Path(out_base)
+    out_base.mkdir(parents=True, exist_ok=True)
+    path = out_base / "run_manifest.json"
+
+    def _default(o):
+        if hasattr(o, "item"):
+            return o.item()
+        if isinstance(o, Path):
+            return str(o)
+        if isinstance(o, (set, tuple)):
+            return list(o)
+        return str(o)
+
+    with open(path, "w") as f:
+        json.dump(dict(manifest), f, indent=2, default=_default)
+    return path
+
+
+def load_run_manifest(out_base: Path | str) -> dict:
+    """Round-trip counterpart of :func:`save_run_manifest`; accepts either
+    the model dir or the manifest file itself."""
+    p = Path(out_base)
+    if p.is_dir():
+        p = p / "run_manifest.json"
+    with open(p) as f:
+        return json.load(f)
+
+
 def results_to_csv(results: Sequence[dict], save_path: Path | str) -> None:
     """Flat trial table (reference detect_injected_thoughts.py:2136-2137 uses
     pandas; plain csv here keeps the artifact identical without the import).

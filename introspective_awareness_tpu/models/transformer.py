@@ -196,11 +196,17 @@ def merge_chunk(cache: KVCache, cfg: ModelConfig) -> KVCache:
     valid = (
         jnp.arange(RR, dtype=jnp.int32)[None, :] < cache.rlen
     ) & cache.rvalid
+    # Metadata offset is derived from the page index, NOT from mlen
+    # directly: if mlen ever drifted off a page boundary (partially filled
+    # ring merged early), writing mvalid/mpos at mlen would desync them
+    # from the page-aligned payload above. ``off = page * RR`` pins both to
+    # the same slab; tail slots of a short chunk stay masked by ``valid``.
+    off = page * RR
     return cache._replace(
         mk=new_mk, mv=new_mv,
-        mvalid=lax.dynamic_update_slice(cache.mvalid, valid, (0, cache.mlen)),
-        mpos=lax.dynamic_update_slice(cache.mpos, cache.rpos, (0, cache.mlen)),
-        mlen=cache.mlen + cache.rlen,
+        mvalid=lax.dynamic_update_slice(cache.mvalid, valid, (0, off)),
+        mpos=lax.dynamic_update_slice(cache.mpos, cache.rpos, (0, off)),
+        mlen=off + cache.rlen,
         rlen=jnp.int32(0),
     )
 

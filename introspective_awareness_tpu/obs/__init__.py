@@ -1,0 +1,59 @@
+"""First-class observability subsystem.
+
+Four pieces, threaded through runner / sweep / judge / bench / scripts:
+
+- :mod:`~introspective_awareness_tpu.obs.ledger` — structured run ledger:
+  JSONL phase spans (load/extract/prefill/decode/grade/judge) with wall +
+  device-blocked time, tok/s, evals/s/chip, and matching
+  ``jax.profiler.TraceAnnotation`` names for xprof alignment.
+- :mod:`~introspective_awareness_tpu.obs.preflight` — HBM preflight gate:
+  vets ``compiled.memory_analysis()`` against per-device HBM before a
+  freshly-jitted executable runs; fails fast naming the largest temps.
+- :mod:`~introspective_awareness_tpu.obs.compile_stats` — persistent-cache
+  hit/miss counters and per-executable compile seconds for manifests.
+- :mod:`~introspective_awareness_tpu.obs.timing` — the original wall-timer
+  registry, profiler capture, and NaN/Inf sanitizers (promoted from
+  ``utils/observability.py``, which still re-exports for back-compat).
+"""
+
+from introspective_awareness_tpu.obs.compile_stats import CompileAccounting
+from introspective_awareness_tpu.obs.ledger import (
+    PHASES,
+    NullLedger,
+    RunLedger,
+    Span,
+    load_ledger,
+)
+from introspective_awareness_tpu.obs.preflight import (
+    HbmPreflightError,
+    PreflightReport,
+    device_hbm_bytes,
+    preflight,
+    top_temp_buffers,
+)
+from introspective_awareness_tpu.obs.timing import (
+    Timings,
+    enable_compilation_cache,
+    enable_debug_checks,
+    profile_trace,
+    timed,
+)
+
+__all__ = [
+    "CompileAccounting",
+    "HbmPreflightError",
+    "NullLedger",
+    "PHASES",
+    "PreflightReport",
+    "RunLedger",
+    "Span",
+    "Timings",
+    "device_hbm_bytes",
+    "enable_compilation_cache",
+    "enable_debug_checks",
+    "load_ledger",
+    "preflight",
+    "profile_trace",
+    "timed",
+    "top_temp_buffers",
+]

@@ -1,0 +1,142 @@
+"""Compile accounting: persistent-cache hit/miss counters and per-executable
+compile seconds, sourced from ``jax.monitoring`` events.
+
+JAX emits ``/jax/compilation_cache/cache_hits`` / ``cache_misses`` counter
+events and ``/jax/core/compile/backend_compile_duration`` duration events
+for every backend compile. ``jax.monitoring`` only supports appending
+listeners (no unregister), so :class:`CompileAccounting` is a process-wide
+idempotent singleton — ``install()`` registers exactly once and scopes are
+carved out with snapshot/delta semantics:
+
+    acct = CompileAccounting.install()
+    before = acct.snapshot()
+    ... jit / lower / compile ...
+    manifest["compile_stats"] = acct.delta_since(before)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from jax import monitoring
+
+_CACHE_PREFIX = "/jax/compilation_cache/"
+_COMPILE_DURATION_EVENTS = (
+    "/jax/core/compile/backend_compile_duration",
+    "/jax/compilation_cache/cache_retrieval_time_sec",
+    "/jax/compilation_cache/compile_time_saved_sec",
+)
+
+_SHORT = {
+    "/jax/compilation_cache/cache_hits": "cache_hits",
+    "/jax/compilation_cache/cache_misses": "cache_misses",
+    "/jax/compilation_cache/compile_requests_use_cache": "cache_requests",
+    "/jax/compilation_cache/tasks_using_cache": "tasks_using_cache",
+    "/jax/core/compile/backend_compile_duration": "backend_compile",
+    "/jax/compilation_cache/cache_retrieval_time_sec": "cache_retrieval",
+    "/jax/compilation_cache/compile_time_saved_sec": "compile_time_saved",
+}
+
+
+class CompileAccounting:
+    """Singleton collector of compilation-cache counters and compile
+    durations. Thread-safe; listeners stay registered for process life."""
+
+    _instance: Optional["CompileAccounting"] = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self.counters: dict[str, int] = {}
+        # name -> {"count": n, "total_s": s, "max_s": s, "events": [...]}
+        self.durations: dict[str, dict[str, Any]] = {}
+
+    @classmethod
+    def install(cls) -> "CompileAccounting":
+        with cls._lock:
+            if cls._instance is None:
+                inst = cls()
+                monitoring.register_event_listener(inst._on_event)
+                monitoring.register_event_duration_secs_listener(
+                    inst._on_duration)
+                cls._instance = inst
+            return cls._instance
+
+    # -- listeners ---------------------------------------------------------
+
+    def _on_event(self, event: str, **kwargs: Any) -> None:
+        if not event.startswith(_CACHE_PREFIX):
+            return
+        key = _SHORT.get(event, event)
+        with self._mu:
+            self.counters[key] = self.counters.get(key, 0) + 1
+
+    def _on_duration(self, event: str, duration_secs: float,
+                     **kwargs: Any) -> None:
+        if event not in _COMPILE_DURATION_EVENTS:
+            return
+        key = _SHORT.get(event, event)
+        with self._mu:
+            row = self.durations.setdefault(
+                key, {"count": 0, "total_s": 0.0, "max_s": 0.0, "events": []})
+            row["count"] += 1
+            row["total_s"] += duration_secs
+            row["max_s"] = max(row["max_s"], duration_secs)
+            # Per-executable compile seconds; fn_name arrives via kwargs on
+            # newer jaxlibs, else the entry is anonymous.
+            row["events"].append({
+                "secs": round(duration_secs, 4),
+                **{k: v for k, v in kwargs.items() if isinstance(v, (str, int))},
+            })
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._mu:
+            return {
+                "counters": dict(self.counters),
+                "durations": {
+                    k: {"count": v["count"],
+                        "total_s": v["total_s"],
+                        "max_s": v["max_s"],
+                        "events": list(v["events"])}
+                    for k, v in self.durations.items()
+                },
+            }
+
+    def delta_since(self, before: Optional[dict[str, Any]] = None
+                    ) -> dict[str, Any]:
+        """Counters/durations accumulated since ``before`` (a ``snapshot()``),
+        formatted for ``run_manifest.json``."""
+        now = self.snapshot()
+        before = before or {"counters": {}, "durations": {}}
+        counters = {
+            k: v - before["counters"].get(k, 0)
+            for k, v in now["counters"].items()
+            if v - before["counters"].get(k, 0)
+        }
+        durations: dict[str, Any] = {}
+        for k, v in now["durations"].items():
+            prev = before["durations"].get(
+                k, {"count": 0, "total_s": 0.0, "events": []})
+            dcount = v["count"] - prev["count"]
+            if dcount <= 0:
+                continue
+            events = v["events"][len(prev["events"]):]
+            durations[k] = {
+                "count": dcount,
+                "total_s": round(v["total_s"] - prev["total_s"], 4),
+                "max_s": round(max((e["secs"] for e in events), default=0.0), 4),
+                "events": events[-50:],
+            }
+        hits = counters.get("cache_hits", 0)
+        misses = counters.get("cache_misses", 0)
+        out: dict[str, Any] = {"counters": counters, "durations": durations}
+        if hits + misses:
+            out["cache_hit_rate"] = round(hits / (hits + misses), 4)
+        bc = durations.get("backend_compile")
+        if bc:
+            out["compile_s"] = bc["total_s"]
+            out["n_compiles"] = bc["count"]
+        return out

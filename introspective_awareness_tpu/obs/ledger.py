@@ -1,0 +1,285 @@
+"""Structured run ledger: a JSONL event stream of phase spans.
+
+Every long-running phase of a sweep/bench run (``load``, ``extract``,
+``prefill``, ``decode``, ``grade``, ``judge``, ...) opens a :meth:`RunLedger.span`.
+On close the span is appended to the ledger as one JSON line carrying:
+
+- wall time of the block (``wall_s``) and, when a device result was attached
+  via ``span.watch(...)``, the ``block_until_ready``-bracketed device wait
+  (``block_s``) so async dispatch does not under-report device work;
+- throughput: ``tok_per_s`` (when ``tokens`` were recorded) and
+  ``evals_per_s`` / ``evals_per_s_per_chip`` (when ``evals`` were recorded);
+- nesting (``id`` / ``parent`` / ``depth``) so phases compose
+  (``generate`` > ``prefill`` > ``decode``).
+
+Each span also enters a ``jax.profiler.TraceAnnotation`` with the same name,
+so spans line up 1:1 with named regions in an xprof/TensorBoard trace
+captured via ``profile_trace``.
+
+The ledger is cheap enough to leave on unconditionally in-memory; pass a
+path to also stream JSONL to disk (flushed per event, so a preempted run
+keeps everything up to the last closed span).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, Iterator, Optional
+
+import jax
+
+# Conventional phase names; the ledger accepts arbitrary names, these are
+# documentation plus the canonical ordering used by summaries.
+PHASES = ("load", "extract", "prefill", "decode", "generate", "grade", "judge")
+
+SCHEMA_VERSION = 1
+
+
+class Span:
+    """One open phase span. Mutate counters while the block runs."""
+
+    __slots__ = (
+        "name", "id", "parent", "depth", "t0", "tokens", "evals",
+        "attrs", "_watched", "wall_s", "block_s",
+    )
+
+    def __init__(self, name: str, span_id: int, parent: Optional[int],
+                 depth: int, attrs: dict[str, Any]):
+        self.name = name
+        self.id = span_id
+        self.parent = parent
+        self.depth = depth
+        self.t0 = time.perf_counter()
+        self.tokens: Optional[int] = None
+        self.evals: Optional[int] = None
+        self.attrs = attrs
+        self._watched: list[Any] = []
+        self.wall_s: Optional[float] = None
+        self.block_s: Optional[float] = None
+
+    def add_tokens(self, n: int) -> None:
+        self.tokens = (self.tokens or 0) + int(n)
+
+    def add_evals(self, n: int) -> None:
+        self.evals = (self.evals or 0) + int(n)
+
+    def watch(self, result: Any) -> Any:
+        """Register a device array/pytree; span close blocks until it is
+        ready so the recorded wall time includes the device work. Returns
+        ``result`` unchanged so call sites can wrap in-line."""
+        self._watched.append(result)
+        return result
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+
+class RunLedger:
+    """Collects :class:`Span` events in memory and (optionally) as JSONL."""
+
+    def __init__(self, path: Optional[str] = None,
+                 n_chips: Optional[int] = None) -> None:
+        self.path = str(path) if path else None
+        self.n_chips = int(n_chips) if n_chips else jax.device_count()
+        self.events: list[dict[str, Any]] = []
+        self._stack: list[Span] = []
+        self._next_id = 0
+        self._fh = None
+        if self.path:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        self._emit({
+            "ev": "ledger_start",
+            "schema_version": SCHEMA_VERSION,
+            "backend": jax.default_backend(),
+            "n_devices": jax.device_count(),
+            "n_chips": self.n_chips,
+            "device_kind": jax.devices()[0].device_kind,
+            "unix_time": time.time(),
+        })
+
+    # -- event plumbing ----------------------------------------------------
+
+    def _emit(self, record: dict[str, Any]) -> None:
+        self.events.append(record)
+        if self._fh is not None:
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Record an instantaneous point event (e.g. a preflight verdict)."""
+        rec = {"ev": "event", "name": name, "t": time.perf_counter()}
+        rec.update(attrs)
+        self._emit(rec)
+
+    # -- spans -------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, tokens: Optional[int] = None,
+             evals: Optional[int] = None, **attrs: Any) -> Iterator[Span]:
+        sp = Span(name, self._next_id, self._stack[-1].id if self._stack else None,
+                  len(self._stack), dict(attrs))
+        self._next_id += 1
+        if tokens is not None:
+            sp.add_tokens(tokens)
+        if evals is not None:
+            sp.add_evals(evals)
+        self._stack.append(sp)
+        try:
+            with jax.profiler.TraceAnnotation(f"ledger/{name}"):
+                yield sp
+        finally:
+            block_s = 0.0
+            if sp._watched:
+                tb = time.perf_counter()
+                jax.block_until_ready(sp._watched)
+                block_s = time.perf_counter() - tb
+                sp._watched = []
+            sp.wall_s = time.perf_counter() - sp.t0
+            sp.block_s = block_s
+            # Pop self even if inner spans leaked (exception paths).
+            while self._stack and self._stack[-1] is not sp:
+                self._stack.pop()
+            if self._stack:
+                self._stack.pop()
+            self._emit(self._span_record(sp))
+
+    def _span_record(self, sp: Span) -> dict[str, Any]:
+        rec: dict[str, Any] = {
+            "ev": "span",
+            "phase": sp.name,
+            "id": sp.id,
+            "parent": sp.parent,
+            "depth": sp.depth,
+            "wall_s": round(sp.wall_s, 6),
+            "block_s": round(sp.block_s, 6),
+        }
+        wall = max(sp.wall_s, 1e-9)
+        if sp.tokens is not None:
+            rec["tokens"] = sp.tokens
+            rec["tok_per_s"] = round(sp.tokens / wall, 3)
+        if sp.evals is not None:
+            rec["evals"] = sp.evals
+            rec["evals_per_s"] = round(sp.evals / wall, 4)
+            rec["evals_per_s_per_chip"] = round(
+                sp.evals / wall / max(self.n_chips, 1), 4)
+        rec.update(sp.attrs)
+        return rec
+
+    # -- aggregation -------------------------------------------------------
+
+    def spans(self) -> list[dict[str, Any]]:
+        return [e for e in self.events if e.get("ev") == "span"]
+
+    def summary(self) -> dict[str, Any]:
+        """Per-phase aggregate suitable for ``run_manifest.json``.
+
+        Only top-level occurrences of each phase are summed (a ``decode``
+        nested inside a ``generate`` still gets its own phase row, but a
+        phase is never double-counted against itself).
+        """
+        per: dict[str, dict[str, Any]] = {}
+        by_id = {e["id"]: e for e in self.spans()}
+
+        def ancestor_same_phase(e: dict[str, Any]) -> bool:
+            p = e.get("parent")
+            while p is not None:
+                pe = by_id.get(p)
+                if pe is None:
+                    return False
+                if pe["phase"] == e["phase"]:
+                    return True
+                p = pe.get("parent")
+            return False
+
+        for e in self.spans():
+            if ancestor_same_phase(e):
+                continue
+            row = per.setdefault(e["phase"], {
+                "count": 0, "wall_s": 0.0, "block_s": 0.0,
+                "tokens": 0, "evals": 0,
+            })
+            row["count"] += 1
+            row["wall_s"] += e["wall_s"]
+            row["block_s"] += e.get("block_s", 0.0)
+            row["tokens"] += e.get("tokens", 0) or 0
+            row["evals"] += e.get("evals", 0) or 0
+        for row in per.values():
+            wall = max(row["wall_s"], 1e-9)
+            row["wall_s"] = round(row["wall_s"], 4)
+            row["block_s"] = round(row["block_s"], 4)
+            if row["tokens"]:
+                row["tok_per_s"] = round(row["tokens"] / wall, 3)
+            else:
+                del row["tokens"]
+            if row["evals"]:
+                row["evals_per_s"] = round(row["evals"] / wall, 4)
+                row["evals_per_s_per_chip"] = round(
+                    row["evals"] / wall / max(self.n_chips, 1), 4)
+            else:
+                del row["evals"]
+        ordered = {p: per[p] for p in PHASES if p in per}
+        ordered.update({p: v for p, v in per.items() if p not in ordered})
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "n_chips": self.n_chips,
+            "phases": ordered,
+        }
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "RunLedger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class NullLedger:
+    """No-op drop-in used when observability is disabled; keeps call sites
+    unconditional (``ledger.span(...)`` always works)."""
+
+    n_chips = 1
+    events: list = []
+    path = None
+
+    @contextlib.contextmanager
+    def span(self, name: str, **kw: Any) -> Iterator[Span]:
+        yield Span(name, 0, None, 0, {})
+
+    def event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def spans(self) -> list:
+        return []
+
+    def summary(self) -> dict[str, Any]:
+        return {}
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "NullLedger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+def load_ledger(path: str) -> list[dict[str, Any]]:
+    """Parse a JSONL ledger file back into event dicts."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,234 @@
+"""HBM preflight gate: vet a freshly-compiled executable before running it.
+
+Round 5's bench died mid-sweep with RESOURCE_EXHAUSTED because
+``generate_tokens_prefix`` compiled to ~20 simultaneous 256 MB padded
+broadcast temps — a failure mode that is fully visible in
+``compiled.memory_analysis()`` *before* the executable ever runs. This
+module turns that into a gate:
+
+    compiled = jax.jit(fn, ...).lower(*args).compile()
+    preflight(compiled, label="generate", budget_frac=0.9)  # raises if over
+
+The report logs argument/output/temp/generated-code bytes against the
+per-device HBM budget and, on failure, names the top-k largest temp
+buffers (parsed from the optimized HLO) so the offending op is identifiable
+without an xprof session.
+
+``stats=`` accepts any object exposing the ``CompiledMemoryStats``
+attributes, so tests can exercise the gate with synthetic numbers; when the
+real backend reports no per-device memory (CPU ``memory_stats()`` is None)
+an explicit ``hbm_bytes=`` or the device-kind table below supplies the
+budget, else the gate degrades to log-only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+import sys
+from typing import Any, Optional
+
+import jax
+
+# Per-device HBM by device_kind substring (bytes). Used when the backend
+# does not expose memory_stats() (e.g. during AOT analysis off-device).
+_HBM_BY_KIND: tuple[tuple[str, int], ...] = (
+    ("v6e", 32 * 1024**3),
+    ("v6 lite", 32 * 1024**3),
+    ("v5p", 95 * 1024**3),
+    ("v5e", 16 * 1024**3),
+    ("v5 lite", 16 * 1024**3),
+    ("v4", 32 * 1024**3),
+    ("v3", 16 * 1024**3),
+    ("v2", 8 * 1024**3),
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1, "f8e4m3b11fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# "  %fusion.123 = bf16[256,512,8,64]{3,2,1,0:T(8,128)(2,1)} fusion(...)"
+_HLO_INSTR = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*([a-z0-9]+)\[([0-9,]*)\]"
+    r"[^ ]*\s+([a-z\-]+)\(")
+
+
+class HbmPreflightError(RuntimeError):
+    """Raised when a compiled executable's memory footprint exceeds the
+    configured HBM budget. Carries the full :class:`PreflightReport`."""
+
+    def __init__(self, report: "PreflightReport"):
+        super().__init__(report.message())
+        self.report = report
+
+
+@dataclasses.dataclass
+class PreflightReport:
+    label: str
+    ok: bool
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    generated_code_bytes: int
+    total_bytes: int
+    hbm_bytes: Optional[int]
+    budget_frac: float
+    budget_bytes: Optional[int]
+    top_temp_buffers: list[dict[str, Any]]
+
+    def as_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def message(self) -> str:
+        def gb(n: Optional[int]) -> str:
+            return "?" if n is None else f"{n / 1024**3:.3f}GiB"
+
+        head = (
+            f"HBM preflight [{self.label}]: "
+            f"{'OK' if self.ok else 'OVER BUDGET'} — total {gb(self.total_bytes)} "
+            f"(args {gb(self.argument_bytes)} + out {gb(self.output_bytes)} + "
+            f"temps {gb(self.temp_bytes)} + code {gb(self.generated_code_bytes)}) "
+            f"vs budget {gb(self.budget_bytes)} "
+            f"({self.budget_frac:.2f} x {gb(self.hbm_bytes)} HBM)"
+        )
+        if self.top_temp_buffers:
+            rows = "\n".join(
+                f"    {b['bytes'] / 1024**2:9.1f}MiB  {b['shape']:<28s} {b['op']}"
+                for b in self.top_temp_buffers)
+            head += "\n  top temp buffers:\n" + rows
+        return head
+
+
+def device_hbm_bytes(device: Optional[Any] = None) -> Optional[int]:
+    """Best-effort per-device memory: live ``memory_stats()`` limit if the
+    backend reports one, else the device-kind table, else None."""
+    device = device or jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if stats and stats.get("bytes_limit"):
+        return int(stats["bytes_limit"])
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for sub, size in _HBM_BY_KIND:
+        if sub in kind:
+            return size
+    return None
+
+
+def _shape_bytes(dtype: str, dims: str) -> Optional[int]:
+    nbytes = _DTYPE_BYTES.get(dtype)
+    if nbytes is None:
+        return None
+    n = 1
+    for d in dims.split(","):
+        d = d.strip()
+        if d:
+            n *= int(d)
+    return n * nbytes
+
+
+def top_temp_buffers(hlo_text: str, top_k: int = 8) -> list[dict[str, Any]]:
+    """Scan optimized HLO text for the largest intermediate values.
+
+    Heuristic (buffer-assignment proto would be exact but needs xla protos):
+    every non-parameter instruction's result array, ranked by unpadded size.
+    Padded layouts like ``T(8,128)(2,1)`` can inflate the real allocation
+    up to ~2x beyond what is reported here; the op names are the point.
+    """
+    best: dict[str, tuple[int, str]] = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_INSTR.match(line)
+        if not m:
+            continue
+        name, dtype, dims, opcode = m.groups()
+        if opcode in ("parameter", "constant"):
+            continue
+        size = _shape_bytes(dtype, dims)
+        if size is None:
+            continue
+        shape = f"{dtype}[{dims}]"
+        prev = best.get(name)
+        if prev is None or size > prev[0]:
+            best[name] = (size, shape)
+    ranked = sorted(best.items(), key=lambda kv: -kv[1][0])[:top_k]
+    return [{"op": name, "bytes": size, "shape": shape}
+            for name, (size, shape) in ranked]
+
+
+def preflight(
+    compiled: Optional[Any] = None,
+    *,
+    stats: Optional[Any] = None,
+    label: str = "executable",
+    device: Optional[Any] = None,
+    hbm_bytes: Optional[int] = None,
+    budget_frac: float = 0.9,
+    top_k: int = 8,
+    enforce: bool = True,
+    ledger: Optional[Any] = None,
+    verbose: bool = False,
+) -> PreflightReport:
+    """Check a compiled executable's memory plan against the HBM budget.
+
+    Pass either ``compiled`` (anything with ``memory_analysis()``, e.g. the
+    result of ``jit(f).lower(...).compile()``) or a ``stats`` object with
+    ``CompiledMemoryStats``-style attributes. Raises
+    :class:`HbmPreflightError` when over budget and ``enforce`` is True;
+    with no resolvable HBM size the gate is log-only (``ok=True``).
+    """
+    if stats is None:
+        if compiled is None:
+            raise ValueError("preflight needs `compiled` or `stats`")
+        stats = compiled.memory_analysis()
+
+    def _get(name: str) -> int:
+        v = getattr(stats, name, 0) or 0
+        return int(v) if math.isfinite(v) else 0
+
+    arg_b = _get("argument_size_in_bytes")
+    out_b = _get("output_size_in_bytes")
+    tmp_b = _get("temp_size_in_bytes")
+    code_b = _get("generated_code_size_in_bytes")
+    alias_b = _get("alias_size_in_bytes")
+    total = arg_b + out_b + tmp_b + code_b - alias_b
+
+    if hbm_bytes is None:
+        hbm_bytes = device_hbm_bytes(device)
+    budget = int(hbm_bytes * budget_frac) if hbm_bytes else None
+    ok = budget is None or total <= budget
+
+    top: list[dict[str, Any]] = []
+    if not ok:
+        buffers = getattr(stats, "temp_buffers", None)
+        if buffers:
+            top = sorted((dict(b) for b in buffers),
+                         key=lambda b: -b.get("bytes", 0))[:top_k]
+        elif compiled is not None:
+            try:
+                top = top_temp_buffers(compiled.as_text(), top_k=top_k)
+            except Exception:
+                top = []
+
+    report = PreflightReport(
+        label=label, ok=ok,
+        argument_bytes=arg_b, output_bytes=out_b, temp_bytes=tmp_b,
+        generated_code_bytes=code_b, total_bytes=total,
+        hbm_bytes=int(hbm_bytes) if hbm_bytes else None,
+        budget_frac=budget_frac, budget_bytes=budget,
+        top_temp_buffers=top,
+    )
+    if ledger is not None:
+        ledger.event("hbm_preflight", **report.as_dict())
+    if verbose or not ok:
+        # stderr: bench.py's stdout is a single machine-parseable JSON doc.
+        print(f"[obs] {report.message()}", file=sys.stderr, flush=True)
+    if not ok and enforce:
+        raise HbmPreflightError(report)
+    return report

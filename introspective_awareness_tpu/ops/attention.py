@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from introspective_awareness_tpu.parallel.compat import tpu_compiler_params
+
 _NEG_INF = -1e30
 
 
@@ -228,7 +230,8 @@ def flash_attention(
             pltpu.VMEM((g_block * block_q, 1), jnp.float32),  # running sum
             pltpu.VMEM((g_block * block_q, D), jnp.float32),  # accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -41,6 +41,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from introspective_awareness_tpu.parallel.compat import tpu_compiler_params
+
 _NEG_INF = -1e30
 
 
@@ -290,7 +292,8 @@ def cached_attention(
             pltpu.VMEM((KVH, block_q * groups, 1), jnp.float32),  # running sum
             pltpu.VMEM((KVH, block_q * groups, D), jnp.float32),  # accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

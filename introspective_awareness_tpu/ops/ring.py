@@ -106,7 +106,7 @@ def ring_attention(
     ALSO sharded over (the model runtime composes sp with dp/tp); the ring
     only ever communicates over ``axis_name``.
     """
-    shard_map = jax.shard_map
+    from introspective_awareness_tpu.parallel.compat import shard_map
 
     seq_spec = P(batch_axis, axis_name, head_axis, None)
     pos_spec = P(batch_axis, axis_name)

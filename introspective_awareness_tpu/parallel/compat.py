@@ -1,0 +1,40 @@
+"""Version-portable wrappers for JAX APIs that moved between releases.
+
+The kernels were written against the promoted ``jax.shard_map`` /
+``pltpu.CompilerParams`` names; older jaxlibs (0.4.x) ship them as
+``jax.experimental.shard_map.shard_map`` / ``pltpu.TPUCompilerParams``.
+These shims prefer the new spelling and fall back, so the same code runs
+on both without scattering getattr checks through the op library.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs,
+              axis_names: Optional[frozenset] = None, **kw):
+    """``jax.shard_map`` with the new-API ``axis_names`` semantics.
+
+    ``axis_names`` selects the mesh axes the body is manual over; on old
+    jax the complement is passed as ``auto=`` (same meaning)."""
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return native(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def tpu_compiler_params(pltpu_module: Any, **kwargs):
+    """``pltpu.CompilerParams(...)`` falling back to ``TPUCompilerParams``."""
+    cls = getattr(pltpu_module, "CompilerParams", None) or getattr(
+        pltpu_module, "TPUCompilerParams")
+    return cls(**kwargs)

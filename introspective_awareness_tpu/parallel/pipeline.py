@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from typing import TYPE_CHECKING
 
 from introspective_awareness_tpu.models.config import ModelConfig
+from introspective_awareness_tpu.parallel import compat
 from introspective_awareness_tpu.parallel.mesh import PIPE_AXIS
 from introspective_awareness_tpu.parallel.sharding import mark_varying
 
@@ -114,7 +115,7 @@ def pipeline_hidden(
     l_per_stage = cfg.n_layers // n_stages
 
     @partial(
-        jax.shard_map,
+        compat.shard_map,
         mesh=mesh,
         axis_names=frozenset({PIPE_AXIS}),
         # The trunk's leading (layer) dim splits over pipe; everything else

@@ -116,7 +116,12 @@ def mark_varying(tree, axis_name: str):
     pcast = getattr(jax.lax, "pcast", None)
     if pcast is not None:
         return pcast(tree, axis_name, to="varying")
-    return jax.lax.pvary(tree, axis_name)  # pragma: no cover - older JAX
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:  # pragma: no cover - older JAX
+        return pvary(tree, axis_name)
+    # Pre-pvary JAX (<=0.4.x): shard_map has no varying-axis type system;
+    # every value inside the manual region already behaves as varying.
+    return tree
 
 
 def with_sharding_constraint(

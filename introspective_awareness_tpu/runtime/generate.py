@@ -312,6 +312,12 @@ def generate_tokens_prefix(
         ),
         length=jnp.int32(P0),
     )
+    # Materialize the broadcast cache ONCE. Without the barrier XLA remats
+    # the fused broadcast_in_dim into every per-layer ``cache.k[l]`` slice of
+    # the decode loop, allocating ~n_layers simultaneous full-cache temps in
+    # a padded layout (2.0x at head_dim 64) — the round-5 bench
+    # RESOURCE_EXHAUSTED (BENCH_r05.json, transformer.py squeeze temps).
+    cache = lax.optimization_barrier(cache)
 
     # 3) Per-row suffixes as one steered continuation chunk (ring path).
     steer_prompt, steer_decode = _steer_specs(spec, suffix_mask)

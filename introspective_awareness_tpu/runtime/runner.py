@@ -25,9 +25,11 @@ import numpy as np
 from introspective_awareness_tpu.models.config import ModelConfig
 from introspective_awareness_tpu.models.registry import get_layer_at_fraction
 from introspective_awareness_tpu.models.tokenizer import Tokenizer, pad_batch
-from introspective_awareness_tpu.models.transformer import forward, make_positions
+from introspective_awareness_tpu.obs import NullLedger
+from introspective_awareness_tpu.obs.preflight import preflight as _hbm_preflight
 from introspective_awareness_tpu.parallel import ShardingRules
 from introspective_awareness_tpu.parallel import sharding as shax
+from introspective_awareness_tpu.models.transformer import forward, make_positions
 from introspective_awareness_tpu.runtime.generate import (
     GenSpec,
     generate_tokens,
@@ -52,6 +54,8 @@ class ModelRunner:
         seed: int = 0,
         prefix_cache: bool = True,
         prefix_min: int = 64,
+        ledger=None,
+        hbm_budget_frac: Optional[float] = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -68,6 +72,13 @@ class ModelRunner:
         self._calls = 0
         self.n_layers = cfg.n_layers
         self.hf_path = model_name
+        # Observability: every phase runs under a ledger span (NullLedger
+        # keeps call sites unconditional); with an HBM budget fraction set,
+        # generate executables are AOT-compiled and preflighted against
+        # per-device HBM before they ever run (obs.preflight).
+        self.ledger = ledger if ledger is not None else NullLedger()
+        self.hbm_budget_frac = hbm_budget_frac
+        self._aot_cache: dict = {}
         # Sequence parallelism: with a seq mesh axis > 1, S>1 chunks attend
         # via ring attention (ops/ring.py) and the shared-prefix split is
         # disabled (its suffix pass runs the cached-attention branch, which
@@ -76,6 +87,14 @@ class ModelRunner:
         if mesh is not None:
             sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
             if sizes.get("seq", 1) > 1:
+                if cfg.sliding_window is not None:
+                    raise ValueError(
+                        "sequence parallelism (mesh seq axis = "
+                        f"{sizes['seq']}) is incompatible with "
+                        f"sliding_window={cfg.sliding_window}: the ring-"
+                        "attention path has no sliding-window support. Use "
+                        "sp=1 or a config without sliding_window."
+                    )
                 self.sp_mesh = mesh
 
     # -- helpers ------------------------------------------------------------
@@ -179,6 +198,39 @@ class ModelRunner:
             arr[i, Ls - len(v):] = v
         return jnp.asarray(arr)
 
+    def _aot_preflight(self, fn, fn_args: tuple, fn_kwargs: dict):
+        """AOT-compile a generate executable and gate it on the HBM budget.
+
+        ``jit(f).lower(...).compile()`` exposes ``memory_analysis()`` before
+        anything runs; an executable whose argument+output+temp footprint
+        exceeds ``hbm_budget_frac`` x per-device HBM raises HbmPreflightError
+        naming the largest temp buffers — instead of a RESOURCE_EXHAUSTED
+        mid-sweep (the round-5 bench failure). Compiled executables are
+        cached per abstract input signature, so steady-state calls pay one
+        dict lookup."""
+        traced = [a for a in fn_args if not isinstance(a, ModelConfig)]
+        leaves, treedef = jax.tree.flatten(traced)
+        key = (
+            fn.__name__,
+            tuple(sorted(
+                (k, v) for k, v in fn_kwargs.items() if k != "sp_mesh"
+            )),
+            fn_kwargs.get("sp_mesh") is not None,
+            str(treedef),
+            tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
+        )
+        compiled = self._aot_cache.get(key)
+        if compiled is None:
+            compiled = fn.lower(*fn_args, **fn_kwargs).compile()
+            _hbm_preflight(
+                compiled,
+                label=fn.__name__,
+                budget_frac=self.hbm_budget_frac,
+                ledger=self.ledger,
+            )
+            self._aot_cache[key] = compiled
+        return compiled
+
     def _decode_row(self, row: np.ndarray) -> str:
         out = []
         eos = set(int(e) for e in self.tokenizer.eos_ids)
@@ -221,11 +273,16 @@ class ModelRunner:
                 cap = np.concatenate(
                     [pad_amounts + token_idx, np.full((ids.shape[0] - B,), S - 1)]
                 ).astype(np.int32)
-            r = forward(
-                self.params, self.cfg, ids, mask, make_positions(mask),
-                capture_pos=jnp.asarray(cap), capture=True, logits_mode="none",
-                sp_mesh=self.sp_mesh,
-            )
+            with self.ledger.span(
+                "extract", batch=B, seq=int(S), model=self.model_name
+            ) as sp:
+                r = forward(
+                    self.params, self.cfg, ids, mask, make_positions(mask),
+                    capture_pos=jnp.asarray(cap), capture=True,
+                    logits_mode="none", sp_mesh=self.sp_mesh,
+                )
+                sp.watch(r.captured)
+                sp.add_tokens(int(lens.sum()))
             outs.append(np.asarray(r.captured, np.float32)[:, :B, :])
         return np.concatenate(outs, axis=1)
 
@@ -335,17 +392,42 @@ class ModelRunner:
             ),
         )
         if L0:
-            tokens = generate_tokens_prefix(
+            fn = generate_tokens_prefix
+            fn_args = (
                 self.params, self.cfg,
                 jnp.asarray(np.asarray(rows[0][:L0], np.int32)),
-                ids, mask, spec, max_new_tokens=max_new_tokens,
+                ids, mask, spec,
             )
+            fn_kwargs = {"max_new_tokens": max_new_tokens}
         else:
-            tokens = generate_tokens(
-                self.params, self.cfg, ids, mask, spec,
-                max_new_tokens=max_new_tokens, sp_mesh=self.sp_mesh,
+            fn = generate_tokens
+            fn_args = (self.params, self.cfg, ids, mask, spec)
+            fn_kwargs = {
+                "max_new_tokens": max_new_tokens, "sp_mesh": self.sp_mesh,
+            }
+        with self.ledger.span(
+            "generate", batch=B, batch_padded=int(Bp), seq=int(S),
+            prefix_len=int(L0), max_new_tokens=int(max_new_tokens),
+            model=self.model_name,
+        ) as sp:
+            if self.hbm_budget_frac is not None:
+                compiled = self._aot_preflight(fn, fn_args, fn_kwargs)
+                tokens = compiled(*(
+                    a for a in fn_args if not isinstance(a, ModelConfig)
+                ))
+            else:
+                tokens = fn(*fn_args, **fn_kwargs)
+            sp.watch(tokens)
+            tokens = np.asarray(tokens)
+            # Honest decode throughput: count real generated tokens (stop at
+            # EOS/pad) over the B live rows, not Bp x max_new upper bound.
+            eos = np.array(
+                list(self.tokenizer.eos_ids) + [self.tokenizer.pad_id]
             )
-        tokens = np.asarray(tokens)
+            done = np.isin(tokens[:B], eos)
+            sp.add_tokens(int(np.where(
+                done.any(axis=1), done.argmax(axis=1) + 1, tokens.shape[1]
+            ).sum()))
         if debug:
             steered_prompt = int(
                 ((np.arange(S)[None, :] >= starts[:B, None]) & (np.asarray(mask)[:B] > 0)).sum()

@@ -1,111 +1,18 @@
-"""Tracing, profiling, and sanitizer hooks (SURVEY.md §5.1-5.2).
+"""Back-compat shim: the instrumentation that lived here was promoted into
+the :mod:`introspective_awareness_tpu.obs` package. Import from there."""
 
-The reference has neither (its only instrumentation is tqdm bars and one
-evals/sec print, eval_utils.py:766-767). Here:
+from introspective_awareness_tpu.obs.timing import (  # noqa: F401
+    Timings,
+    enable_compilation_cache,
+    enable_debug_checks,
+    profile_trace,
+    timed,
+)
 
-- ``timed`` — ``block_until_ready``-bracketed wall timers that accumulate
-  into a ``Timings`` registry; the sweep writes them into
-  ``run_manifest.json``.
-- ``profile_trace`` — ``jax.profiler`` trace capture around a phase
-  (view in TensorBoard / xprof).
-- ``enable_debug_checks`` — the CI "sanitizer" mode: NaN/Inf checks inside
-  every jitted computation. The functional JAX design removes the
-  reference's hook-mutation race surface entirely (SURVEY.md §5.2), so
-  numeric checks are the remaining sanitizer class.
-"""
-
-from __future__ import annotations
-
-import contextlib
-import time
-from collections import defaultdict
-from typing import Iterator, Optional
-
-import jax
-
-
-class Timings:
-    """Accumulates named wall-clock durations (seconds)."""
-
-    def __init__(self) -> None:
-        self._totals: dict[str, float] = defaultdict(float)
-        self._counts: dict[str, int] = defaultdict(int)
-
-    def add(self, name: str, seconds: float) -> None:
-        self._totals[name] += seconds
-        self._counts[name] += 1
-
-    def as_dict(self) -> dict[str, float]:
-        return {f"{k}_s": round(v, 4) for k, v in sorted(self._totals.items())}
-
-    def counts(self) -> dict[str, int]:
-        return dict(self._counts)
-
-
-@contextlib.contextmanager
-def timed(
-    name: str,
-    timings: Optional[Timings] = None,
-    result=None,
-    verbose: bool = False,
-) -> Iterator[None]:
-    """Wall-time a block; if ``result`` (array/pytree) is given, block until
-    it is ready so device work is included in the measurement."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        if result is not None:
-            jax.block_until_ready(result)
-        dt = time.perf_counter() - t0
-        if timings is not None:
-            timings.add(name, dt)
-        if verbose:
-            print(f"[timing] {name}: {dt:.3f}s")
-
-
-@contextlib.contextmanager
-def profile_trace(log_dir: Optional[str]) -> Iterator[None]:
-    """jax.profiler trace around a phase; no-op when ``log_dir`` is None."""
-    if log_dir is None:
-        yield
-        return
-    with jax.profiler.trace(str(log_dir)):
-        yield
-
-
-def enable_debug_checks(nans: bool = True, infs: bool = True) -> None:
-    """CI sanitizer mode: raise on NaN/Inf produced inside jit."""
-    jax.config.update("jax_debug_nans", nans)
-    jax.config.update("jax_debug_infs", infs)
-
-
-def enable_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
-    """Wire the JAX persistent compilation cache (SURVEY.md §5.4 plan).
-
-    Sweep re-entry after preemption reuses the same executable shapes, so a
-    warm process start should pay near-zero compile time. Thresholds are
-    dropped to zero so even the small tiny-model test executables cache
-    (default JAX skips entries compiled in <1s).
-
-    Pure optimization: an unwritable cache location (read-only HOME in a pod
-    batch job) degrades to a warning and returns None, never aborts the run.
-    """
-    import os
-
-    cache_dir = (
-        cache_dir
-        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
-        or os.path.join(
-            os.path.expanduser("~"), ".cache", "introspective_awareness_tpu", "xla"
-        )
-    )
-    try:
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except OSError as e:
-        print(f"[warn] compilation cache disabled ({cache_dir}: {e})")
-        return None
-    return str(cache_dir)
+__all__ = [
+    "Timings",
+    "enable_compilation_cache",
+    "enable_debug_checks",
+    "profile_trace",
+    "timed",
+]

@@ -29,13 +29,20 @@ def main() -> None:
     ap.add_argument("--max-new", type=int, default=40)
     ap.add_argument("--trace-dir", default="/tmp/iat_decode_trace")
     ap.add_argument("--bf16", action="store_true", help="skip int8/fp8kv")
+    ap.add_argument("--obs-ledger", default=None,
+                    help="stream phase-span JSONL here (default: in-memory)")
+    ap.add_argument("--hbm-budget-frac", type=float, default=0.9,
+                    help="AOT HBM preflight budget fraction; 0 disables")
     args = ap.parse_args()
 
     import jax
 
+    from introspective_awareness_tpu import obs
     from introspective_awareness_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()
+    obs.CompileAccounting.install()
+    ledger = obs.RunLedger(path=args.obs_ledger)
 
     import dataclasses
 
@@ -53,13 +60,22 @@ def main() -> None:
     if not args.bf16:
         cfg = dataclasses.replace(cfg, kv_cache_dtype="fp8")
     dtype = jax.numpy.bfloat16
-    init = jax.jit(init_params, static_argnames=("cfg", "dtype"))
-    params = init(cfg, jax.random.key(0), dtype=dtype)
-    jax.block_until_ready(params)
-    if not args.bf16:
-        params = quantize_params(params, bits=8, dtype=dtype, include_embed=True)
+    with ledger.span("load", model="profile-1b"):
+        init = jax.jit(init_params, static_argnames=("cfg", "dtype"))
+        params = init(cfg, jax.random.key(0), dtype=dtype)
+        jax.block_until_ready(params)
+        if not args.bf16:
+            params = quantize_params(
+                params, bits=8, dtype=dtype, include_embed=True)
     tok = ByteTokenizer()
-    runner = ModelRunner(params, cfg, tok, model_name="profile-1b")
+    # hbm_budget_frac arms the runner's AOT preflight: the generate
+    # executable is lowered+compiled and its memory_analysis() checked
+    # against HBM BEFORE the first launch, so an over-budget config fails
+    # fast with named temp buffers instead of RESOURCE_EXHAUSTED mid-run.
+    runner = ModelRunner(
+        params, cfg, tok, model_name="profile-1b", ledger=ledger,
+        hbm_budget_frac=args.hbm_budget_frac or None,
+    )
 
     from bench import _build_workload
 
@@ -77,7 +93,10 @@ def main() -> None:
     run(0)
     print(f"warmup {time.perf_counter() - t0:.1f}s", file=sys.stderr)
     t0 = time.perf_counter()
-    run(1)
+    with ledger.span("generate", batch=args.batch,
+                     max_new_tokens=args.max_new, steady_state=True) as sp:
+        sp.add_tokens(args.batch * args.max_new)
+        run(1)
     dt = time.perf_counter() - t0
     steps = args.max_new - 1
     print(f"steady run: {dt:.2f}s, {1e3 * dt / args.max_new:.2f} ms/token",
@@ -166,6 +185,10 @@ def main() -> None:
         print("  -- top 20 ops --")
         for n, v in sorted(by_name.items(), key=lambda kv: -kv[1])[:20]:
             print(f"  {v:9.1f} ms  {n[:110]}")
+
+    print("\n== ledger phase summary ==", file=sys.stderr)
+    print(json.dumps(ledger.summary(), indent=2), file=sys.stderr)
+    ledger.close()
 
 
 if __name__ == "__main__":

@@ -25,6 +25,13 @@ try:
 except Exception:
     pass
 
+# Sanitizer lane: IAT_DEBUG_CHECKS=1 runs the whole suite with NaN/Inf
+# checks enabled inside every jitted computation (CI's second tier-1 job).
+if os.environ.get("IAT_DEBUG_CHECKS"):
+    from introspective_awareness_tpu.obs import enable_debug_checks  # noqa: E402
+
+    enable_debug_checks()
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,306 @@
+"""Tier-1 tests for the obs/ subsystem: run ledger, HBM preflight gate,
+compile accounting, manifest round-trip, and the runner-level validation
+that rides along with the observability PR."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.obs import (
+    CompileAccounting,
+    HbmPreflightError,
+    NullLedger,
+    RunLedger,
+    load_ledger,
+    preflight,
+    top_temp_buffers,
+)
+
+
+# ---------------------------------------------------------------------------
+# RunLedger
+# ---------------------------------------------------------------------------
+
+
+class TestRunLedger:
+    def test_span_nesting_ids(self):
+        led = RunLedger()
+        with led.span("generate") as outer:
+            with led.span("prefill"):
+                pass
+            with led.span("decode"):
+                pass
+        spans = led.spans()
+        assert [s["phase"] for s in spans] == ["prefill", "decode", "generate"]
+        gen = spans[-1]
+        assert gen["parent"] is None and gen["depth"] == 0
+        for child in spans[:2]:
+            assert child["parent"] == gen["id"]
+            assert child["depth"] == 1
+        assert outer.wall_s is not None and gen["wall_s"] >= 0
+
+    def test_throughput_math(self):
+        import time
+
+        led = RunLedger(n_chips=4)
+        with led.span("decode") as sp:
+            sp.add_tokens(100)
+            sp.add_tokens(100)
+            time.sleep(0.02)  # dominate the 1e-6 s wall_s rounding
+        with led.span("judge", evals=80) as sp:
+            time.sleep(0.02)
+        dec, judge = led.spans()
+        assert dec["tokens"] == 200
+        assert dec["tok_per_s"] == pytest.approx(200 / dec["wall_s"], rel=1e-2)
+        assert judge["evals"] == 80
+        assert judge["evals_per_s"] == pytest.approx(
+            80 / judge["wall_s"], rel=1e-2)
+        # per-chip divides by the ledger's n_chips, not device_count
+        assert judge["evals_per_s_per_chip"] == pytest.approx(
+            judge["evals_per_s"] / 4, rel=1e-2)
+
+    def test_watch_blocks_device_result(self):
+        led = RunLedger()
+        with led.span("prefill") as sp:
+            y = sp.watch(jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+        (rec,) = led.spans()
+        assert rec["block_s"] >= 0
+        assert float(np.asarray(y)[0, 0]) == 64.0
+
+    def test_jsonl_schema_roundtrip(self, tmp_path):
+        path = tmp_path / "sub" / "ledger.jsonl"
+        led = RunLedger(path=str(path), n_chips=2)
+        with led.span("extract", model="m") as sp:
+            sp.add_tokens(10)
+        led.event("hbm_preflight", ok=True)
+        led.close()
+
+        events = load_ledger(str(path))
+        assert events[0]["ev"] == "ledger_start"
+        assert events[0]["schema_version"] == 1
+        assert events[0]["n_chips"] == 2
+        kinds = [e["ev"] for e in events]
+        assert kinds == ["ledger_start", "span", "event"]
+        span = events[1]
+        assert span["phase"] == "extract" and span["model"] == "m"
+        assert span["tokens"] == 10 and "tok_per_s" in span
+        # every line was valid standalone JSON (load_ledger parsed them all)
+        assert len(path.read_text().strip().splitlines()) == 3
+
+    def test_summary_excludes_same_phase_nesting(self):
+        led = RunLedger(n_chips=1)
+        with led.span("extract") as outer:
+            outer.add_tokens(50)
+            with led.span("extract") as inner:  # runner-level under sweep-level
+                inner.add_tokens(50)
+            with led.span("decode") as d:
+                d.add_tokens(7)
+        phases = led.summary()["phases"]
+        # nested same-phase span is not double-counted
+        assert phases["extract"]["count"] == 1
+        assert phases["extract"]["tokens"] == 50
+        # different nested phase still gets its own row
+        assert phases["decode"]["tokens"] == 7
+        # canonical ordering puts extract before decode
+        assert list(phases) == ["extract", "decode"]
+
+    def test_summary_survives_exception(self):
+        led = RunLedger()
+        with pytest.raises(RuntimeError):
+            with led.span("grade"):
+                raise RuntimeError("boom")
+        assert led.spans()[0]["phase"] == "grade"
+        assert led._stack == []
+
+    def test_null_ledger_is_inert(self):
+        led = NullLedger()
+        with led.span("decode") as sp:
+            sp.add_tokens(5)
+            sp.watch(jnp.zeros(3))
+        led.event("x", a=1)
+        assert led.spans() == [] and led.summary() == {}
+        led.close()
+
+
+# ---------------------------------------------------------------------------
+# HBM preflight
+# ---------------------------------------------------------------------------
+
+
+class _FakeStats:
+    """Duck-typed CompiledMemoryStats."""
+
+    def __init__(self, temp=0, arg=0, out=0, code=0, alias=0, buffers=None):
+        self.temp_size_in_bytes = temp
+        self.argument_size_in_bytes = arg
+        self.output_size_in_bytes = out
+        self.generated_code_size_in_bytes = code
+        self.alias_size_in_bytes = alias
+        if buffers is not None:
+            self.temp_buffers = buffers
+
+
+class TestPreflight:
+    def test_under_budget_passes(self):
+        rep = preflight(stats=_FakeStats(temp=100, arg=50),
+                        hbm_bytes=10_000, budget_frac=0.9)
+        assert rep.ok and rep.total_bytes == 150
+        assert rep.budget_bytes == 9_000
+
+    def test_over_budget_raises_naming_buffers(self):
+        bufs = [
+            {"op": "fusion.7", "bytes": 9_000, "shape": "bf16[256,512,8,64]"},
+            {"op": "broadcast.2", "bytes": 4_000, "shape": "f32[64,64]"},
+        ]
+        with pytest.raises(HbmPreflightError) as ei:
+            preflight(stats=_FakeStats(temp=20_000, buffers=bufs),
+                      label="synthetic", hbm_bytes=10_000, budget_frac=0.5)
+        rep = ei.value.report
+        assert not rep.ok
+        assert rep.top_temp_buffers[0]["op"] == "fusion.7"
+        # the error message names the offenders and the verdict
+        assert "fusion.7" in str(ei.value)
+        assert "OVER BUDGET" in str(ei.value)
+
+    def test_over_budget_enforce_false_returns_report(self):
+        rep = preflight(stats=_FakeStats(temp=20_000), hbm_bytes=10_000,
+                        enforce=False)
+        assert not rep.ok
+
+    def test_no_hbm_known_degrades_to_log_only(self):
+        # CPU devices report no memory_stats and no kind-table entry.
+        rep = preflight(stats=_FakeStats(temp=1 << 60))
+        assert rep.ok and rep.budget_bytes is None
+
+    def test_real_compiled_executable_over_budget(self):
+        compiled = jax.jit(
+            lambda x: (x @ x) @ (x @ x)
+        ).lower(jnp.ones((64, 64))).compile()
+        with pytest.raises(HbmPreflightError) as ei:
+            preflight(compiled, label="tiny", hbm_bytes=1024, budget_frac=0.5)
+        rep = ei.value.report
+        assert rep.total_bytes > 512
+        # top buffers were parsed from real HLO text
+        assert rep.top_temp_buffers, "expected named HLO buffers"
+        assert all(b["bytes"] > 0 for b in rep.top_temp_buffers)
+
+    def test_real_compiled_executable_under_budget(self):
+        compiled = jax.jit(lambda x: x + 1).lower(jnp.ones(8)).compile()
+        rep = preflight(compiled, hbm_bytes=1 << 30)
+        assert rep.ok
+
+    def test_preflight_emits_ledger_event(self):
+        led = RunLedger()
+        preflight(stats=_FakeStats(temp=1), hbm_bytes=100, ledger=led)
+        evs = [e for e in led.events if e.get("name") == "hbm_preflight"]
+        assert len(evs) == 1 and evs[0]["ok"] is True
+
+    def test_top_temp_buffers_parses_hlo(self):
+        hlo = """
+          %param.1 = f32[8,8]{1,0} parameter(0)
+          %big = bf16[256,512]{1,0:T(8,128)(2,1)} fusion(%param.1), kind=kLoop
+          ROOT %small = f32[4]{0} add(%param.1, %param.1)
+        """
+        top = top_temp_buffers(hlo, top_k=4)
+        names = [b["op"] for b in top]
+        assert "big" in names and "param.1" not in names
+        assert top[0]["op"] == "big"
+        assert top[0]["bytes"] == 256 * 512 * 2
+
+
+# ---------------------------------------------------------------------------
+# Compile accounting
+# ---------------------------------------------------------------------------
+
+
+class TestCompileAccounting:
+    def test_install_is_idempotent_singleton(self):
+        a = CompileAccounting.install()
+        b = CompileAccounting.install()
+        assert a is b
+
+    def test_delta_captures_fresh_compile(self):
+        acct = CompileAccounting.install()
+        before = acct.snapshot()
+
+        # A shape that cannot already be jit-cached in this process.
+        @jax.jit
+        def f(x):
+            return (x * 3).sum()
+
+        f(jnp.ones((3, 5, 7))).block_until_ready()
+        delta = acct.delta_since(before)
+        assert delta["durations"].get("backend_compile", {}).get("count", 0) >= 1
+        assert delta.get("n_compiles", 0) >= 1
+        assert delta.get("compile_s", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# Manifest persistence round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestManifestRoundtrip:
+    def test_save_load_roundtrip_with_nonjson_leaves(self, tmp_path):
+        from pathlib import Path
+
+        from introspective_awareness_tpu.metrics import (
+            load_run_manifest,
+            save_run_manifest,
+        )
+
+        manifest = {
+            "model": "m",
+            "np_scalar": np.float32(1.5),
+            "np_int": np.int64(7),
+            "path": Path("/tmp/x"),
+            "a_set": {"p", "q"},
+            "ledger": {"phases": {"decode": {"tok_per_s": 10.0}}},
+        }
+        p = save_run_manifest(manifest, tmp_path)
+        assert p.name == "run_manifest.json"
+        # loadable via dir or file path
+        got_dir = load_run_manifest(tmp_path)
+        got_file = load_run_manifest(p)
+        assert got_dir == got_file
+        assert got_dir["np_scalar"] == 1.5
+        assert got_dir["np_int"] == 7
+        assert got_dir["path"] == "/tmp/x"
+        assert sorted(got_dir["a_set"]) == ["p", "q"]
+        assert got_dir["ledger"]["phases"]["decode"]["tok_per_s"] == 10.0
+
+
+# ---------------------------------------------------------------------------
+# Runner construction validation (sliding_window x sequence parallelism)
+# ---------------------------------------------------------------------------
+
+
+class TestRunnerSpValidation:
+    def test_sliding_window_with_sp_mesh_rejected(self):
+        from introspective_awareness_tpu.models.config import tiny_config
+        from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+        from introspective_awareness_tpu.parallel import MeshConfig, build_mesh
+        from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+        import dataclasses
+
+        mesh = build_mesh(MeshConfig(dp=1, tp=1, ep=1, sp=8))
+        cfg = dataclasses.replace(tiny_config(), sliding_window=64)
+        with pytest.raises(ValueError, match="sliding_window"):
+            ModelRunner({}, cfg, ByteTokenizer(), mesh=mesh)
+
+    def test_sliding_window_without_sp_ok(self):
+        from introspective_awareness_tpu.models.config import tiny_config
+        from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+        from introspective_awareness_tpu.parallel import MeshConfig, build_mesh
+        from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+        import dataclasses
+
+        mesh = build_mesh(MeshConfig(dp=8, tp=1, ep=1, sp=1))
+        cfg = dataclasses.replace(tiny_config(), sliding_window=64)
+        runner = ModelRunner({}, cfg, ByteTokenizer(), mesh=mesh)
+        assert runner.sp_mesh is None
