@@ -202,6 +202,127 @@ def _timed_config(runner, cfg, tok, batch, max_new, iters, label) -> dict:
     return r
 
 
+def _sched_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
+    """Continuous scheduler vs fixed batches on a mixed-budget trial queue.
+
+    The queue cycles mostly-short budgets with one long straggler per cycle
+    (ragged generation lengths — the sweep's reality once EOS/stop-seqs land
+    at different steps). The fixed-batch baseline takes the queue in order,
+    ``slots`` rows at a time, each batch running to its longest member's
+    budget — the cost model of the legacy path, where every row waits out
+    the slowest. The continuous path drains the same queue through ``slots``
+    persistent decode rows. Outputs are compared trial-for-trial (greedy)
+    against budget-grouped batch references, so "faster" is only reported
+    alongside "bit-identical".
+
+    Two deliberate knobs make the comparison sharp rather than flattering:
+
+    * Both paths run on a ``seq_multiple=16`` runner. The refill pass (and
+      the batch path's suffix prefill) costs one [slots, Ss] forward, and Ss
+      is ``padded_len - prefix_split`` — coarse 64-token buckets inflate Ss
+      (and hence every refill) by up to 48 wasted positions. Finer buckets
+      also push the shared-prefix split right up against the steering start,
+      which exercises the per-slot steer-start-inside-suffix operand.
+    * The decode budget is at least 256 tokens so the comparison is
+      decode-dominated, like the real sweep (max-tokens 100+ on models where
+      a decode step costs far more than a suffix refill). At tiny budgets
+      the chunk quantization (RING_CHUNK=16) erases the short/long spread.
+    """
+    import time as _time
+
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    # Dedicated section runner: same params, finer seq buckets (see above).
+    # Both the baseline and the scheduler use it, so the comparison is fair.
+    runner = ModelRunner(
+        runner.params, cfg, tok, model_name="bench-sched",
+        seq_multiple=16, batch_multiple=slots, ledger=ledger,
+    )
+
+    N = 3 * slots
+    sched_max = max(max_new, 256)
+    prompts, vecs, starts = _build_workload(cfg, tok, N)
+    layers = [int(cfg.n_layers * 0.6)] * N
+    strengths = [4.0] * N
+    # 5 short trials per long one; cycle length 6 against `slots` rows per
+    # fixed batch means every in-order batch contains at least one straggler.
+    cyc = [max(2, sched_max // 8)] * 5 + [sched_max]
+    budgets = [cyc[i % len(cyc)] for i in range(N)]
+
+    def run_batch():
+        out = []
+        for i in range(0, N, slots):
+            out.extend(runner.generate_batch_with_grid_steering(
+                prompts[i:i + slots], layers[i:i + slots],
+                list(vecs[i:i + slots]), strengths[i:i + slots],
+                max_new_tokens=max(budgets[i:i + slots]), temperature=0.0,
+                steering_start_positions=starts[i:i + slots], seed=0,
+            ))
+        return out
+
+    def run_sched():
+        return runner.generate_grid_scheduled(
+            prompts, layers, list(vecs), strengths, max_new_tokens=sched_max,
+            temperature=0.0, steering_start_positions=starts,
+            budgets=budgets, seed=0, slots=slots, refill_frac=0.5,
+        )
+
+    run_batch()  # compile both paths before timing
+    run_sched()
+    t0 = _time.perf_counter()
+    run_batch()
+    t_batch = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    sched_out = run_sched()
+    t_sched = _time.perf_counter() - t0
+
+    # Identity probe (untimed): group the queue BY budget so each batch-path
+    # reference generation stops exactly at its trial's own budget — the
+    # only way the fixed-batch path can express per-trial budgets at all.
+    ref: dict[int, str] = {}
+    for b in sorted(set(cyc)):
+        idx = [i for i in range(N) if budgets[i] == b]
+        out = runner.generate_batch_with_grid_steering(
+            [prompts[i] for i in idx], [layers[i] for i in idx],
+            [vecs[i] for i in idx], [strengths[i] for i in idx],
+            max_new_tokens=b, temperature=0.0,
+            steering_start_positions=[starts[i] for i in idx], seed=0,
+        )
+        for j, i in enumerate(idx):
+            ref[i] = out[j]
+    identical = all(sched_out[i] == ref[i] for i in range(N))
+
+    # Slot-occupancy / padded-waste gauges from the scheduler's ledger span
+    # (runtime.scheduler also emits a per-chunk slot_occupancy event stream).
+    sched_spans = [
+        e for e in ledger.events
+        if e.get("ev") == "span" and e.get("phase") == "generate_scheduled"
+    ]
+    gauges = sched_spans[-1] if sched_spans else {}
+    r = {
+        "slots": slots,
+        "queue_trials": N,
+        "budget_cycle": cyc,
+        "batch_time_s": round(t_batch, 3),
+        "continuous_time_s": round(t_sched, 3),
+        "speedup": round(t_batch / t_sched, 3) if t_sched > 0 else None,
+        "evals_per_sec_batch": round(N / t_batch, 3),
+        "evals_per_sec_continuous": round(N / t_sched, 3),
+        "outputs_identical": identical,
+        "mean_slot_occupancy": gauges.get("mean_slot_occupancy"),
+        "padded_row_waste_steps": gauges.get("padded_row_waste_steps"),
+        "refills": gauges.get("refills"),
+        "decode_chunks": gauges.get("chunks"),
+    }
+    log(
+        f"  [scheduler] {N} mixed-budget trials ({cyc}) x {slots} slots: "
+        f"batch {t_batch:.2f}s vs continuous {t_sched:.2f}s -> "
+        f"{r['speedup']}x, identical={identical}, "
+        f"occupancy={r['mean_slot_occupancy']}"
+    )
+    return r
+
+
 def _hbm_model(runner, cfg, batch, prompt_len, max_new) -> float:
     """Modeled HBM bytes read per decode step: every parameter once + the
     full KV-cache buffer (the decode attention reads all T slots each step
@@ -313,6 +434,9 @@ def main() -> None:
         _timed_config(runner, cfg, tok, b, max_new, iters, "bf16")
         for b in batches
     ]
+
+    # ---- continuous scheduler vs fixed batches on a mixed-budget queue -----
+    sched = _sched_compare(runner, cfg, tok, batches[0], max_new, ledger)
 
     # ---- int8 weight-quantized variant at the best bf16 batch --------------
     if on_tpu:
@@ -499,6 +623,7 @@ def main() -> None:
             for r in results
         ],
         "token_stats": stats,
+        "scheduler": sched,
         "phases": ledger.summary().get("phases", {}),
         "hbm_preflight": preflight_verdict,
         "hbm_devices": hbm_devices,

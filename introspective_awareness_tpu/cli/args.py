@@ -57,6 +57,19 @@ def build_parser() -> argparse.ArgumentParser:
                              "--batch-size (auto). Per-example layer/strength "
                              "operands keep it one compiled executable; "
                              "per-cell artifacts are unchanged.")
+    parser.add_argument("--scheduler", type=str, default="batch",
+                        choices=["batch", "continuous"],
+                        help="Decode scheduling: fixed batches per pass "
+                             "(batch) or continuous batching — the whole "
+                             "trial queue drains through --batch-size "
+                             "persistent decode slots, refilled as rows hit "
+                             "EOS/stop, so no cell waits out another cell's "
+                             "ragged tail. Greedy outputs are bit-identical "
+                             "per trial (unsharded / dp-only meshes; under "
+                             "tp, near-tied argmaxes can flip — normal "
+                             "cross-executable float drift); temperature>0 "
+                             "draws differ (per-trial RNG streams instead "
+                             "of per-batch).")
     parser.add_argument("-od", "--output-dir", type=str, default=DEFAULT_OUTPUT_DIR)
     parser.add_argument("-dt", "--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float16", "float32"])

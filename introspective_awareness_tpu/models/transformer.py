@@ -170,18 +170,26 @@ def merge_ring(cache: KVCache, cfg: ModelConfig) -> KVCache:
     )
 
 
-def merge_chunk(cache: KVCache, cfg: ModelConfig) -> KVCache:
+def merge_chunk(cache: KVCache, cfg: ModelConfig, page=None) -> KVCache:
     """Fold the chunk ring into the MERGED decode buffer and reset the ring.
 
     The decode-loop counterpart of ``merge_ring``: called every ring-capacity
     decode steps, its read-modify-write slab is the merged buffer (bounded by
-    the decode length), not the prompt-sized prefill buffer."""
+    the decode length), not the prompt-sized prefill buffer.
+
+    ``page`` (traced int, optional): write the chunk at that page index
+    instead of ``mlen // RR`` and leave ``mlen`` untouched. The continuous
+    scheduler pins ``mlen`` to the full buffer and recycles pages modulo the
+    page count; validity then rests on ``mvalid`` alone, which this write
+    fully overwrites for the page."""
     L, RR, B = cache.rk.shape[:3]
     vd = cache.v.shape[-1]
+    explicit_page = page is not None
     # The chunk becomes one whole page: the update spans every non-page
     # dim, so the write is tile-complete and XLA never reads back
     # previously merged pages.
-    page = cache.mlen // RR
+    if not explicit_page:
+        page = cache.mlen // RR
     new_mk = lax.dynamic_update_slice(
         cache.mk, cache.rk.astype(cache.mk.dtype)[:, None],
         (0, page, 0, 0, 0, 0),
@@ -206,7 +214,69 @@ def merge_chunk(cache: KVCache, cfg: ModelConfig) -> KVCache:
         mk=new_mk, mv=new_mv,
         mvalid=lax.dynamic_update_slice(cache.mvalid, valid, (0, off)),
         mpos=lax.dynamic_update_slice(cache.mpos, cache.rpos, (0, off)),
-        mlen=off + cache.rlen,
+        mlen=cache.mlen if explicit_page else off + cache.rlen,
+        rlen=jnp.int32(0),
+    )
+
+
+def reset_slots(cache: KVCache, reset_mask, prefix_len: int) -> KVCache:
+    """Invalidate per-row decode state for slots about to be refilled.
+
+    Clears the suffix region of the prefill ``slot_mask`` (positions
+    ``>= prefix_len``), the rows' ring validity, and the rows' merged-buffer
+    validity, so a recycled slot carries no KV from its previous tenant.
+    The shared prefix (positions ``< prefix_len``) is preserved.
+    """
+    T = cache.k.shape[2]
+    suffix = jnp.arange(T, dtype=jnp.int32)[None, :] >= prefix_len
+    drop = reset_mask[:, None] & suffix
+    new_slot_mask = cache.slot_mask & ~drop
+    new_rvalid = cache.rvalid & ~reset_mask[:, None]
+    new_mvalid = cache.mvalid & ~reset_mask[:, None]
+    return cache._replace(
+        slot_mask=new_slot_mask, rvalid=new_rvalid, mvalid=new_mvalid,
+    )
+
+
+def merge_suffix_slots(
+    cache: KVCache, cfg: ModelConfig, refill_mask
+) -> KVCache:
+    """Fold a suffix-prefill ring into the slot tier for refilled rows only.
+
+    The scheduler runs the per-trial suffix through a fresh ring of exactly
+    the suffix length; this folds that ring into the static suffix region of
+    the prefill buffer (``[:, :, prefix_len:]``) — but only for rows in
+    ``refill_mask``; live rows keep their existing suffix KV untouched.
+    ``prefix_len`` is derived from static shapes: slot capacity minus ring
+    capacity."""
+    L, RR, B = cache.rk.shape[:3]
+    T = cache.k.shape[2]
+    P0 = T - RR  # shared-prefix length, static
+    rows_k = jnp.swapaxes(cache.rk, 1, 2).astype(cache.k.dtype)  # [L,B,RR,..]
+    sel = refill_mask[None, :, None, None, None]
+    new_k = cache.k.at[:, :, P0:].set(
+        jnp.where(sel, rows_k, cache.k[:, :, P0:])
+    )
+    if cache.v.shape[-1]:
+        rows_v = jnp.swapaxes(cache.rv, 1, 2).astype(cache.v.dtype)
+        new_v = cache.v.at[:, :, P0:].set(
+            jnp.where(sel, rows_v, cache.v[:, :, P0:])
+        )
+    else:
+        new_v = cache.v
+    valid = (
+        jnp.arange(RR, dtype=jnp.int32)[None, :] < cache.rlen
+    ) & cache.rvalid
+    sel2 = refill_mask[:, None]
+    new_slot_mask = cache.slot_mask.at[:, P0:].set(
+        jnp.where(sel2, valid, cache.slot_mask[:, P0:])
+    )
+    new_positions = cache.positions.at[:, P0:].set(
+        jnp.where(sel2, cache.rpos, cache.positions[:, P0:])
+    )
+    return cache._replace(
+        k=new_k, v=new_v,
+        slot_mask=new_slot_mask, positions=new_positions,
         rlen=jnp.int32(0),
     )
 

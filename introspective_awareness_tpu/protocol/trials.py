@@ -32,6 +32,7 @@ def run_trial_pass(
     batch_size: int = 256,
     seed: Optional[int] = None,
     debug: bool = False,
+    scheduler: str = "batch",
 ) -> list[dict]:
     """One batched pass of a trial type over (concept, trial) tasks.
 
@@ -41,11 +42,27 @@ def run_trial_pass(
     trial_type. Note the reference's re-eval path counts the literal string
     "forced" while writing "forced_injection" (its §7.5 bug); this framework
     uses "forced_injection" everywhere.
+
+    ``scheduler="continuous"`` drains the tasks through the persistent
+    decode-slot scheduler (``batch_size`` slots) instead of fixed batches —
+    identical greedy results, rows freed at EOS instead of at batch end.
     """
     if trial_type not in TRIAL_TYPES:
         raise ValueError(f"unknown trial_type {trial_type!r} (expected {TRIAL_TYPES})")
     injected = trial_type != "control"
     eff_strength = strength if injected else 0.0
+    if scheduler == "continuous":
+        grid_tasks = [
+            (c, t, layer_fraction, layer_idx, strength) for c, t in tasks
+        ]
+        return run_grid_pass(
+            runner, trial_type, grid_tasks,
+            lambda _lf, c: vectors[c],
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            batch_size=batch_size, seed=seed, scheduler="continuous",
+        )
+    if scheduler != "batch":
+        raise ValueError(f"unknown scheduler {scheduler!r}")
 
     # The rendered prompt depends only on (trial_number, trial_type) — memoize
     # so a 50-concept sweep tokenizes each distinct trial prompt once instead
@@ -106,6 +123,7 @@ def run_grid_pass(
     temperature: float = 1.0,
     batch_size: int = 256,
     seed: Optional[int] = None,
+    scheduler: str = "batch",
 ) -> list[dict]:
     """One batched pass where every row may belong to a DIFFERENT
     (layer, strength) cell — the fused-sweep path.
@@ -114,9 +132,17 @@ def run_grid_pass(
     (models/transformer.py SteerSpec), so the whole layer x strength grid
     packs into full batches on one executable instead of one underfilled
     generate call per cell. Same result schema as ``run_trial_pass``.
+
+    ``scheduler="continuous"`` hands the WHOLE task list to the decode-slot
+    scheduler (``batch_size`` slots): finished rows are harvested and
+    refilled with pending tasks instead of waiting out a fixed batch, so no
+    cell pays for another cell's ragged tail. Cell provenance is positional
+    — results come back in task order either way.
     """
     if trial_type not in TRIAL_TYPES:
         raise ValueError(f"unknown trial_type {trial_type!r} (expected {TRIAL_TYPES})")
+    if scheduler not in ("batch", "continuous"):
+        raise ValueError(f"unknown scheduler {scheduler!r}")
     injected = trial_type != "control"
 
     render_cache: dict[int, tuple[str, Optional[int]]] = {}
@@ -127,6 +153,42 @@ def run_grid_pass(
                 runner.tokenizer, runner.model_name, trial_num, trial_type
             )
         return render_cache[trial_num]
+
+    if scheduler == "continuous":
+        prompts, starts, vecs, layers, strengths = [], [], [], [], []
+        for concept, trial_num, lf, layer_idx, strength in tasks:
+            prompt, steer_start = rendered(trial_num)
+            prompts.append(prompt)
+            starts.append(steer_start)
+            vecs.append(np.asarray(vector_lookup(lf, concept), np.float32))
+            layers.append(layer_idx)
+            strengths.append(strength if injected else 0.0)
+        responses = runner.generate_grid_scheduled(
+            prompts,
+            layer_indices=layers,
+            steering_vectors=vecs,
+            strengths=strengths,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            steering_start_positions=starts,
+            seed=seed,
+            slots=batch_size,
+        )
+        return [
+            {
+                "concept": concept,
+                "trial": trial_num,
+                "response": response,
+                "injected": injected,
+                "layer": layer_idx,
+                "layer_fraction": lf,
+                "strength": strength,
+                "detected": check_concept_mentioned(response, concept),
+                "trial_type": trial_type,
+            }
+            for (concept, trial_num, lf, layer_idx, strength), response
+            in zip(tasks, responses)
+        ]
 
     results: list[dict] = []
     for start in range(0, len(tasks), batch_size):

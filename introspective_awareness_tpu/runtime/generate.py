@@ -32,6 +32,8 @@ from introspective_awareness_tpu.models.transformer import (
     make_positions,
     merge_chunk,
     merge_ring,
+    merge_suffix_slots,
+    reset_slots,
 )
 
 # Decode steps per chunk. Per-step KV appends touch only the small chunk
@@ -76,6 +78,11 @@ class GenSpec(NamedTuple):
     # "Answer: YES|NO" instead of generating its full budget. None disables
     # matching (and is the common executable: n_stop is a static shape).
     stop_seqs: Optional[jax.Array] = None
+    # Optional [B] bool. False marks batch-filler rows (runner pads the
+    # batch by repeating the last row): they are forced done at step 0 and
+    # emit only pad, so the EOS early-exit is gated by real rows alone
+    # instead of waiting out duplicates. None = all rows live.
+    live: Optional[jax.Array] = None
 
 
 def _chunk_plan(max_new_tokens: int) -> tuple[int, int]:
@@ -151,7 +158,11 @@ def _sample_and_decode(
 
     key, sub = jax.random.split(spec.rng)
     tok0 = sample(logits0, sub)
+    if spec.live is not None:
+        tok0 = jnp.where(spec.live, tok0, spec.pad_id)
     done0 = jnp.isin(tok0, spec.eos_ids)
+    if spec.live is not None:
+        done0 = done0 | ~spec.live
     if use_stop:
         tail0 = jnp.full((B, stop.shape[1]), -2, jnp.int32).at[:, -1].set(tok0)
         done0 = done0 | stop_hit(tail0)
@@ -200,7 +211,22 @@ def _sample_and_decode(
             cache = merge_chunk(cache, cfg)
         return cc + 1, cache, prev, done, key, tokens, tail
 
-    if max_new_tokens > 1:
+    if max_new_tokens > 1 and n_chunks == 1:
+        # Single-chunk fast path: the whole generation fits one ring chunk,
+        # so the while_loop wrapper (and its chunk merge — the ring is
+        # discarded on return) is pure dispatch overhead. The cond still
+        # matches the while_loop's chunk-granular early exit: if every row
+        # finished on the first token, the chunk never runs.
+        def run_chunk(carry):
+            def inner(i, c):
+                return step(c, i + 1)
+
+            return lax.fori_loop(0, ch, inner, carry)
+
+        carry = (cache, tok0, done0, key, tokens0, tail0)
+        carry = lax.cond(jnp.all(done0), lambda c: c, run_chunk, carry)
+        tokens = carry[4]
+    elif max_new_tokens > 1:
         carry = (jnp.int32(0), cache, tok0, done0, key, tokens0, tail0)
         _, _, _, _, _, tokens, _ = lax.while_loop(chunk_cond, chunk_body, carry)
     else:
@@ -352,3 +378,320 @@ def generate_tokens_prefix(
         params, cfg, cache, r.logits, steer_decode, spec, true_len,
         max_new_tokens, n_chunks, ch,
     )
+
+
+# ---------------------------------------------------------------------------
+# Continuous-batching slot scheduler: device-side step functions
+# ---------------------------------------------------------------------------
+# The host loop (runtime.scheduler) drains a queue of trials through a
+# persistent [B]-slot decode state. Three jitted executables serve the whole
+# sweep regardless of which trials occupy which slots, because every
+# per-trial quantity (steer layer/strength/vector/start, budget, RNG) is a
+# per-slot runtime operand:
+#
+#   scheduler_init    — prefill the shared prefix once, broadcast it into
+#                       every slot, pin the merged buffer open.
+#   scheduler_refill  — inject new trials into freed slots via a masked
+#                       suffix pass against the shared prefix (exactly
+#                       generate_tokens_prefix's slot semantics), sample
+#                       each new trial's first token.
+#   scheduler_decode_chunk — one ring chunk of decode with PER-SLOT done
+#                       masking (done rows contribute attn_mask 0 so their
+#                       ring/merged entries stay invalid), folded into the
+#                       merged buffer at an explicit recycled page.
+#
+# Page recycling: the merged buffer keeps P = n_chunks pages and the host
+# writes chunk g at page g % P with ``mlen`` pinned to the full buffer, so
+# ``mvalid`` alone gates reads. This is sound because chunks are globally
+# synchronized across slots: a slot admitted at chunk g_a is forced done by
+# budget within n_chunks chunks, so every chunk it still needs lives in the
+# last P pages; pages from before its admission hold mvalid=False for its
+# row (it was masked done then), and a refill clears the slot's whole
+# mvalid row before any new KV lands.
+
+
+class SlotState(NamedTuple):
+    """Per-slot decode state threaded between scheduler executables."""
+
+    prev: jax.Array  # [B] int32 — last sampled token (next step's input)
+    done: jax.Array  # [B] bool — slot finished (or empty)
+    n_emitted: jax.Array  # [B] int32 — tokens emitted so far (incl. first)
+    true_len: jax.Array  # [B] int32 — prefix + real suffix context length
+    budget: jax.Array  # [B] int32 — per-trial max new tokens
+    steer_layer: jax.Array  # [B] int32
+    steer_strength: jax.Array  # [B] f32
+    steer_vectors: jax.Array  # [B, H] f32
+    keydata: jax.Array  # [B, 2] uint32 — per-slot PRNG key data
+    tail: jax.Array  # [B, Ls] int32 — rolling stop-sequence tail (Ls may be 0)
+
+
+class SchedSpec(NamedTuple):
+    """Queue-wide (not per-slot) sampling operands."""
+
+    temperature: jax.Array  # f32 scalar; <= 0 → greedy
+    eos_ids: jax.Array  # [n_eos] int32
+    pad_id: jax.Array  # int32 scalar
+    stop_seqs: Optional[jax.Array] = None  # [n_stop, Ls], -1 = wildcard
+
+
+def _slot_sample(logits: jax.Array, keydata: jax.Array, temperature):
+    """Per-slot sampling: same argmax(logits + T*gumbel) formula as
+    ``_sample_and_decode`` but with an independent PRNG stream per slot, so
+    a trial's samples don't depend on which slots its queue neighbours
+    landed in. Returns (tokens [B], advanced keydata [B, 2])."""
+    keys = jax.random.wrap_key_data(keydata)
+    nk = jax.vmap(lambda k: jax.random.split(k))(keys)  # [B, 2] keys
+    g = jax.vmap(lambda k, l: jax.random.gumbel(k, l.shape, l.dtype))(
+        nk[:, 0], logits
+    )
+    temp = jnp.maximum(temperature, 0.0)
+    tok = jnp.argmax(logits + temp * g, axis=-1).astype(jnp.int32)
+    return tok, jax.random.key_data(nk[:, 1])
+
+
+def _stop_hit(stop: jax.Array, tail: jax.Array) -> jax.Array:
+    return jnp.any(
+        jnp.all((stop[None] < 0) | (tail[:, None, :] == stop[None]), axis=-1),
+        axis=-1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "slots", "suffix_len", "max_new_tokens", "stop_width"),
+)
+def scheduler_init(
+    params: dict,
+    cfg: ModelConfig,
+    prefix_ids: jax.Array,  # [P0] shared unpadded prompt prefix
+    *,
+    slots: int,
+    suffix_len: int,
+    max_new_tokens: int,  # queue-wide max budget; sizes the chunk plan
+    stop_width: int = 0,  # Ls of the stop-seq table (0 = no stop matching)
+) -> tuple:
+    """Build the persistent slot cache + empty slot state.
+
+    Prefills the shared prefix once at batch 1, broadcasts it into all
+    ``slots`` rows (identical to ``generate_tokens_prefix`` steps 1-2), and
+    allocates the decode tiers: a chunk-sized ring plus ``n_chunks`` merged
+    pages with ``mlen`` pinned to the full buffer (page recycling — see the
+    module comment). All slots start done/empty."""
+    B = slots
+    P0 = prefix_ids.shape[0]
+    L = cfg.n_layers
+    dtype = params["embed"].dtype
+    H = params["embed"].shape[1]
+    n_chunks, ch = _chunk_plan(max_new_tokens)
+
+    pcache = init_cache(cfg, 1, P0, dtype)
+    r0 = forward(
+        params, cfg, prefix_ids[None], jnp.ones((1, P0), jnp.int32),
+        jnp.arange(P0, dtype=jnp.int32)[None],
+        cache=pcache, use_cache=True, logits_mode="none", is_prefill=True,
+    )
+
+    T = P0 + suffix_len
+    cache = init_cache(
+        cfg, B, T, dtype, ring_len=ch, merged_pages=n_chunks
+    )
+
+    def put_prefix(dst, src):
+        rows = jnp.broadcast_to(src[:, :1], (L, B) + src.shape[2:])
+        return lax.dynamic_update_slice(
+            dst, rows.astype(dst.dtype), (0, 0, 0, 0, 0)
+        )
+
+    cache = cache._replace(
+        k=put_prefix(cache.k, r0.cache.k),
+        v=put_prefix(cache.v, r0.cache.v) if cache.v.shape[-1] else cache.v,
+        slot_mask=cache.slot_mask.at[:, :P0].set(True),
+        positions=cache.positions.at[:, :P0].set(
+            jnp.arange(P0, dtype=jnp.int32)[None]
+        ),
+        length=jnp.int32(P0),
+        # Pin the merged write-count gate open: with recycled pages the
+        # high-water mark is meaningless and mvalid alone decides validity.
+        mlen=jnp.int32(n_chunks * ch),
+    )
+    # Same rematerialization hazard as generate_tokens_prefix: force the
+    # broadcast cache to exist once, not per-layer inside the decode loop.
+    cache = lax.optimization_barrier(cache)
+
+    state = SlotState(
+        prev=jnp.zeros((B,), jnp.int32),
+        done=jnp.ones((B,), jnp.bool_),  # empty slots are done slots
+        n_emitted=jnp.zeros((B,), jnp.int32),
+        true_len=jnp.full((B,), P0, jnp.int32),
+        budget=jnp.zeros((B,), jnp.int32),
+        steer_layer=jnp.zeros((B,), jnp.int32),
+        steer_strength=jnp.zeros((B,), jnp.float32),
+        steer_vectors=jnp.zeros((B, H), jnp.float32),
+        keydata=jnp.zeros((B, 2), jnp.uint32),
+        tail=jnp.full((B, stop_width), -2, jnp.int32),
+    )
+    return cache, state
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache", "state"))
+def scheduler_refill(
+    params: dict,
+    cfg: ModelConfig,
+    cache,
+    state: SlotState,
+    spec: SchedSpec,
+    suffix_ids: jax.Array,  # [B, Ss] left-padded; garbage rows where ~refill
+    suffix_mask: jax.Array,  # [B, Ss]
+    refill_mask: jax.Array,  # [B] bool — slots to (re)fill this call
+    new_layer: jax.Array,  # [B] int32
+    new_strength: jax.Array,  # [B] f32
+    new_vectors: jax.Array,  # [B, H] f32
+    new_start: jax.Array,  # [B] int32, PADDED SUFFIX coords
+    new_budget: jax.Array,  # [B] int32
+    new_keydata: jax.Array,  # [B, 2] uint32
+) -> tuple:
+    """Inject new trials into the slots in ``refill_mask``.
+
+    Clears the refilled rows' old decode state (suffix slot_mask, ring and
+    merged validity), runs ONE masked suffix pass over the whole batch —
+    live rows ride along with attn_mask 0, so they write nothing valid and
+    their outputs are discarded — folds the fresh suffix KV into the slot
+    tier for refilled rows only, and samples each new trial's first token.
+    Must be called at a chunk boundary (ring folded, ``rlen == 0``), which
+    the host loop guarantees."""
+    B, Ss = suffix_ids.shape
+    L = cache.rk.shape[0]
+    T = cache.k.shape[2]
+    P0 = T - Ss
+    RC = cache.rk.shape[1]  # persistent decode-ring capacity (chunk size)
+    kvh_kd = cache.rk.shape[3:]
+    kvh_vd = cache.rv.shape[3:]
+
+    cache = reset_slots(cache, refill_mask, P0)
+
+    # Swap in a suffix-sized scratch ring; slot + merged tiers stay (live
+    # rows' KV must remain visible to... nothing — their masked pass reads
+    # it but discards the result; refilled rows see prefix-only state).
+    tmp = cache._replace(
+        rk=jnp.zeros((L, Ss, B) + kvh_kd, cache.rk.dtype),
+        rv=jnp.zeros((L, Ss, B) + kvh_vd, cache.rv.dtype),
+        rpos=jnp.zeros((B, Ss), jnp.int32),
+        rvalid=jnp.zeros((B, Ss), jnp.bool_),
+        rlen=jnp.int32(0),
+    )
+
+    m = refill_mask
+    ids = jnp.where(m[:, None], suffix_ids, 0)
+    amask = jnp.where(m[:, None], suffix_mask, 0)
+    prompt_pos_mask = (
+        (jnp.arange(Ss)[None, :] >= new_start[:, None]) & (amask > 0)
+    ).astype(jnp.float32)
+    steer_prompt = SteerSpec(
+        new_layer, new_strength, new_vectors, prompt_pos_mask
+    )
+    suffix_pos = P0 + make_positions(amask)
+    r = forward(
+        params, cfg, ids, amask, suffix_pos,
+        cache=tmp, steer=steer_prompt, use_cache=True, logits_mode="last",
+    )
+    merged = merge_suffix_slots(r.cache, cfg, m)
+    # Restore the persistent (chunk-sized) decode ring, all-invalid.
+    cache = merged._replace(
+        rk=jnp.zeros((L, RC, B) + kvh_kd, cache.rk.dtype),
+        rv=jnp.zeros((L, RC, B) + kvh_vd, cache.rv.dtype),
+        rpos=jnp.zeros((B, RC), jnp.int32),
+        rvalid=jnp.zeros((B, RC), jnp.bool_),
+        rlen=jnp.int32(0),
+    )
+
+    tok0, keydata = _slot_sample(r.logits, new_keydata, spec.temperature)
+    tok0 = jnp.where(m, tok0, spec.pad_id)
+    done0 = jnp.isin(tok0, spec.eos_ids) | (new_budget <= 1)
+    stop = spec.stop_seqs
+    if stop is not None and stop.shape[0] > 0:
+        tail0 = jnp.full((B, stop.shape[1]), -2, jnp.int32).at[:, -1].set(tok0)
+        done0 = done0 | _stop_hit(stop, tail0)
+        new_tail = jnp.where(m[:, None], tail0, state.tail)
+    else:
+        new_tail = state.tail
+
+    state = SlotState(
+        prev=jnp.where(m, tok0, state.prev),
+        done=jnp.where(m, done0, state.done),
+        n_emitted=jnp.where(m, 1, state.n_emitted),
+        true_len=jnp.where(
+            m, P0 + amask.sum(axis=1).astype(jnp.int32), state.true_len
+        ),
+        budget=jnp.where(m, new_budget, state.budget),
+        steer_layer=jnp.where(m, new_layer, state.steer_layer),
+        steer_strength=jnp.where(m, new_strength, state.steer_strength),
+        steer_vectors=jnp.where(m[:, None], new_vectors, state.steer_vectors),
+        keydata=jnp.where(m[:, None], keydata, state.keydata),
+        tail=new_tail,
+    )
+    return cache, state, tok0
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "ch"), donate_argnames=("cache", "state")
+)
+def scheduler_decode_chunk(
+    params: dict,
+    cfg: ModelConfig,
+    cache,
+    state: SlotState,
+    spec: SchedSpec,
+    page: jax.Array,  # int32 — merged page to fold this chunk into
+    *,
+    ch: int,
+) -> tuple:
+    """One ring chunk (``ch`` steps) of decode with per-slot done masking.
+
+    Done/empty rows pass attn_mask 0 — their ring entries stay invalid and
+    they emit pad — so a chunk makes progress for exactly the live slots.
+    The chunk is folded into the merged buffer at ``page`` (host passes the
+    global chunk counter mod n_chunks). Returns the chunk's tokens
+    ``[B, ch]`` for host-side harvesting."""
+    B = state.prev.shape[0]
+    steer_decode = SteerSpec(
+        state.steer_layer,
+        state.steer_strength,
+        state.steer_vectors,
+        jnp.ones((B, 1), jnp.float32),
+    )
+    stop = spec.stop_seqs
+    use_stop = stop is not None and stop.shape[0] > 0
+    tokens0 = jnp.full((B, ch), spec.pad_id, jnp.int32)
+
+    def step(i, carry):
+        cache, prev, done, n_emitted, keydata, tokens, tail = carry
+        alive = ~done
+        step_pos = (state.true_len + n_emitted - 1)[:, None]
+        out = forward(
+            params, cfg, prev[:, None], alive.astype(jnp.int32)[:, None],
+            step_pos, cache=cache, steer=steer_decode, use_cache=True,
+            logits_mode="last",
+        )
+        nxt, keydata = _slot_sample(out.logits, keydata, spec.temperature)
+        nxt = jnp.where(done, spec.pad_id, nxt)
+        n_emitted = n_emitted + alive.astype(jnp.int32)
+        done = done | jnp.isin(nxt, spec.eos_ids) | (n_emitted >= state.budget)
+        if use_stop:
+            tail = jnp.concatenate([tail[:, 1:], nxt[:, None]], axis=1)
+            done = done | _stop_hit(stop, tail)
+        tokens = lax.dynamic_update_slice(tokens, nxt[:, None], (0, i))
+        return out.cache, nxt, done, n_emitted, keydata, tokens, tail
+
+    carry = (
+        cache, state.prev, state.done, state.n_emitted, state.keydata,
+        tokens0, state.tail,
+    )
+    cache, prev, done, n_emitted, keydata, tokens, tail = lax.fori_loop(
+        0, ch, step, carry
+    )
+    if _use_merged(cfg):
+        cache = merge_chunk(cache, cfg, page=page)
+    state = state._replace(
+        prev=prev, done=done, n_emitted=n_emitted, keydata=keydata, tail=tail
+    )
+    return cache, state, tokens

@@ -32,8 +32,13 @@ from introspective_awareness_tpu.parallel import sharding as shax
 from introspective_awareness_tpu.models.transformer import forward, make_positions
 from introspective_awareness_tpu.runtime.generate import (
     GenSpec,
+    _use_merged,
     generate_tokens,
     generate_tokens_prefix,
+)
+from introspective_awareness_tpu.runtime.scheduler import (
+    TrialRequest,
+    run_scheduled,
 )
 
 
@@ -390,6 +395,12 @@ class ModelRunner:
             stop_seqs=(
                 self._stop_token_seqs(stop_strings) if stop_strings else None
             ),
+            # Batch-filler rows (repeats of the last row) are forced done at
+            # step 0: they emit only pad and never gate the EOS early exit.
+            live=(
+                None if Bp == B
+                else self._shard_batch(jnp.arange(Bp) < B)
+            ),
         )
         if L0:
             fn = generate_tokens_prefix
@@ -569,6 +580,158 @@ class ModelRunner:
             seed=seed,
             debug=debug,
         )
+
+    def generate_grid_scheduled(
+        self,
+        prompts: Sequence[str],
+        layer_indices: Sequence[int],
+        steering_vectors: Sequence[np.ndarray],
+        strengths: Sequence[float],
+        max_new_tokens: int = 512,
+        temperature: float = 0.0,
+        steering_start_positions: Optional[Sequence[Optional[int]]] = None,
+        budgets: Optional[Sequence[int]] = None,
+        seed: Optional[int] = None,
+        stop_strings: Optional[Sequence[str]] = None,
+        slots: Optional[int] = None,
+        refill_frac: float = 0.25,
+        **kw,
+    ) -> list[str]:
+        """Continuous-batching counterpart of
+        ``generate_batch_with_grid_steering``: the whole trial list (all
+        grid cells) drains through ``slots`` persistent decode rows
+        (runtime.scheduler), so finished rows free capacity immediately
+        instead of waiting out their batch. Per-trial ``budgets`` cap each
+        row's generation (default: ``max_new_tokens`` for all).
+
+        Eligibility mirrors the shared-prefix path — every prompt must
+        share a prefix no steered row steers inside (the sweep's preamble),
+        no sequence-parallel mesh, and the merged decode tier must be
+        active. Ineligible queues fall back to the fixed-batch path in
+        ``slots``-sized chunks (uniform budgets only: the fallback cannot
+        truncate per-trial without changing sampled text).
+
+        Greedy outputs are bit-identical to the batch path on an unsharded
+        runner or a dp-only mesh (test_scheduler.py). Under tensor
+        parallelism the scheduler's executables partition reductions
+        differently than the batch path's, so near-tied argmaxes can break
+        the other way — the same cross-executable float drift the repo's
+        sharded-vs-unsharded comparisons tolerate, not a semantic
+        divergence.
+        """
+        N = len(prompts)
+        assert N == len(steering_vectors) == len(layer_indices) == len(strengths)
+        if N == 0:
+            return []
+        layer_arr = np.asarray(list(layer_indices), np.int64)
+        if not (
+            (-self.cfg.n_layers <= layer_arr) & (layer_arr < self.cfg.n_layers)
+        ).all():
+            raise ValueError(
+                f"layer_indices {layer_indices} out of range for "
+                f"{self.cfg.n_layers} layers"
+            )
+        layer_arr = layer_arr % self.cfg.n_layers
+        strength_arr = np.asarray(list(strengths), np.float32)
+        budget_list = (
+            [int(max_new_tokens)] * N if budgets is None
+            else [int(b) for b in budgets]
+        )
+        if len(budget_list) != N:
+            raise ValueError("budgets must align with prompts")
+        for b in budget_list:
+            if not (1 <= b <= max_new_tokens):
+                raise ValueError(
+                    f"budget {b} outside [1, {max_new_tokens}]"
+                )
+        slots = int(slots) if slots else max(self.batch_multiple, 8)
+        # More slots than trials just decodes permanently-empty rows; clamp
+        # (costs a shape bucket only when the whole queue is this small).
+        slots = max(1, min(slots, N))
+
+        rows = [self.tokenizer.encode(p) for p in prompts]
+        L0 = 0
+        if self.sp_mesh is None and _use_merged(self.cfg):
+            L0 = self._prefix_split(
+                rows, strength_arr, steering_start_positions
+            )
+        if L0 == 0:
+            # Fixed-batch fallback in slot-sized chunks. One batch call has
+            # a single max_new_tokens, so only a uniform budget is accepted
+            # here; a mixed-budget queue needs the slot path.
+            if len(set(budget_list)) > 1:
+                raise ValueError(
+                    "continuous scheduler ineligible (no shared prefix / "
+                    "seq-parallel mesh / no merged tier) and budgets are "
+                    "non-uniform; use uniform budgets or the batch path"
+                )
+            out: list[str] = []
+            for i in range(0, N, slots):
+                out.extend(self.generate_batch_with_grid_steering(
+                    prompts[i : i + slots],
+                    list(layer_arr[i : i + slots]),
+                    steering_vectors[i : i + slots],
+                    list(strength_arr[i : i + slots]),
+                    max_new_tokens=budget_list[0],
+                    temperature=temperature,
+                    steering_start_positions=(
+                        None if steering_start_positions is None
+                        else steering_start_positions[i : i + slots]
+                    ),
+                    seed=seed,
+                    stop_strings=stop_strings,
+                ))
+            return out
+
+        suffix_rows = [r[L0:] for r in rows]
+        sfx_ids, sfx_mask = pad_batch(
+            suffix_rows, self.tokenizer.pad_id, self.seq_multiple
+        )
+        Ss = sfx_ids.shape[1]
+        pad_amounts = Ss - np.array([len(r) for r in suffix_rows], np.int32)
+        trials = []
+        for i in range(N):
+            sp_i = (
+                None if steering_start_positions is None
+                else steering_start_positions[i]
+            )
+            start = (
+                0 if sp_i is None
+                else int(pad_amounts[i]) + max(int(sp_i) - L0, 0)
+            )
+            trials.append(TrialRequest(
+                suffix_ids=np.asarray(sfx_ids[i], np.int32),
+                suffix_mask=np.asarray(sfx_mask[i], np.int32),
+                steer_layer=int(layer_arr[i]),
+                steer_strength=float(strength_arr[i]),
+                steer_vector=np.asarray(steering_vectors[i], np.float32),
+                steer_start=start,
+                budget=budget_list[i],
+            ))
+        if seed is None:
+            self._calls += 1
+            seed = self._seed * 1_000_003 + self._calls
+        stop = self._stop_token_seqs(stop_strings) if stop_strings else None
+        with self.ledger.span(
+            "generate_scheduled", trials=N, slots=slots, prefix_len=int(L0),
+            suffix_len=int(Ss), max_new_tokens=int(max_new_tokens),
+            model=self.model_name,
+        ) as span:
+            results, stats = run_scheduled(
+                self.params, self.cfg,
+                np.asarray(rows[0][:L0], np.int32), trials,
+                slots=slots, max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                eos_ids=list(self.tokenizer.eos_ids),
+                pad_id=int(self.tokenizer.pad_id),
+                stop_seqs=None if stop is None else np.asarray(stop),
+                seed=int(seed), refill_frac=refill_frac,
+                ledger=self.ledger,
+            )
+            span.add_evals(N)
+            span.add_tokens(int(sum(len(r) for r in results)))
+            span.set(**stats)
+        return [self._decode_row(r) for r in results]
 
     # -- misc ---------------------------------------------------------------
 

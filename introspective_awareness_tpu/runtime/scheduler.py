@@ -1,0 +1,221 @@
+"""Host-side continuous-batching scheduler over the persistent slot cache.
+
+The sweep's batch lifecycle makes every grid cell pay for its slowest row:
+``generate_tokens`` runs one fixed batch per cell, EOS early-exit is
+all-rows (chunk-granular), and padded filler rows decode their full budget.
+This module replaces that lifecycle with Orca-style iteration-level
+scheduling: a queue of trials spanning ALL cells drains through ``slots``
+persistent decode rows. When a row finishes, its tokens are harvested on
+the host and the next pending trial is injected into the freed slot via a
+masked suffix pass against the already-broadcast shared prefix — per-trial
+steer layer/strength/vector/start, budget, and RNG are per-slot runtime
+operands, so the three executables compiled by ``runtime.generate``
+(init / refill / decode-chunk) serve the entire sweep.
+
+Host/device split: the device never blocks on the queue — each decode
+chunk returns its ``[B, ch]`` token slab plus per-slot done flags, the host
+harvests finished slots, and refills are batched (``refill_frac``) so the
+full-batch suffix pass amortizes across several admissions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from introspective_awareness_tpu.models.config import ModelConfig
+from introspective_awareness_tpu.obs import NullLedger
+from introspective_awareness_tpu.runtime.generate import (
+    SchedSpec,
+    _chunk_plan,
+    scheduler_decode_chunk,
+    scheduler_init,
+    scheduler_refill,
+)
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class TrialRequest:
+    """One queued generation: a per-trial suffix plus its steering cell.
+
+    ``suffix_ids``/``suffix_mask`` are left-padded to the queue-wide suffix
+    width; ``steer_start`` is in PADDED SUFFIX coords (0 = steer the whole
+    suffix); ``budget`` is this trial's max new tokens (<= the queue-wide
+    ``max_new_tokens`` that sizes the executables)."""
+
+    suffix_ids: np.ndarray  # [Ss] int32
+    suffix_mask: np.ndarray  # [Ss] int32
+    steer_layer: int
+    steer_strength: float
+    steer_vector: np.ndarray  # [H] f32
+    steer_start: int
+    budget: int
+
+
+def run_scheduled(
+    params: dict,
+    cfg: ModelConfig,
+    prefix_ids: np.ndarray,  # [P0] shared unpadded prefix
+    trials: Sequence[TrialRequest],
+    *,
+    slots: int,
+    max_new_tokens: int,  # queue-wide budget ceiling; sizes the chunk plan
+    temperature: float = 0.0,
+    eos_ids: Sequence[int],
+    pad_id: int,
+    stop_seqs: Optional[np.ndarray] = None,  # [n_stop, Ls], -1 wildcard
+    seed: int = 0,
+    refill_frac: float = 0.25,
+    ledger=None,
+) -> tuple[list[np.ndarray], dict]:
+    """Drain ``trials`` through ``slots`` decode rows; returns per-trial
+    token arrays (input order, length = tokens actually emitted, final
+    EOS/stop token included) plus scheduler stats for the obs ledger.
+
+    Refill policy: admit pending trials when at least
+    ``max(1, refill_frac * slots)`` slots are free, or the machine is idle —
+    batching admissions amortizes the full-batch suffix pass that each
+    refill costs.
+    """
+    ledger = ledger if ledger is not None else NullLedger()
+    B = slots
+    N = len(trials)
+    if N == 0:
+        return [], {"chunks": 0, "refills": 0, "mean_slot_occupancy": 0.0,
+                    "padded_row_waste_steps": 0}
+    Ss = int(trials[0].suffix_ids.shape[0])
+    H = int(trials[0].steer_vector.shape[0])
+    for t in trials:
+        if t.suffix_ids.shape[0] != Ss:
+            raise ValueError("all trial suffixes must share one padded width")
+        if not (1 <= t.budget <= max_new_tokens):
+            raise ValueError(
+                f"trial budget {t.budget} outside [1, {max_new_tokens}]"
+            )
+
+    n_chunks, ch = _chunk_plan(max_new_tokens)
+    stop = None
+    if stop_seqs is not None and len(stop_seqs) > 0:
+        stop = jnp.asarray(np.asarray(stop_seqs, np.int32))
+    stop_width = int(stop.shape[1]) if stop is not None else 0
+
+    cache, state = scheduler_init(
+        params, cfg, jnp.asarray(np.asarray(prefix_ids, np.int32)),
+        slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
+        stop_width=stop_width,
+    )
+    spec = SchedSpec(
+        temperature=jnp.float32(temperature),
+        eos_ids=jnp.asarray(np.asarray(eos_ids, np.int32)),
+        pad_id=jnp.int32(pad_id),
+        stop_seqs=stop,
+    )
+    base_key = jax.random.key(seed)
+    # Per-trial PRNG streams: a trial's samples depend on its queue index
+    # only, never on which slot it lands in or who its neighbours are.
+    trial_keydata = np.asarray(
+        jax.vmap(lambda i: jax.random.key_data(jax.random.fold_in(base_key, i)))(
+            jnp.arange(N)
+        ),
+        np.uint32,
+    )
+
+    slot_trial = np.full(B, -1, np.int64)  # queue index per slot, -1 = free
+    bufs: list[list[np.ndarray]] = [[] for _ in range(B)]
+    results: list[Optional[np.ndarray]] = [None] * N
+    next_trial = 0
+    g = 0  # global chunk counter (drives merged-page recycling)
+    refills = 0
+    occupancy_sum = 0.0
+    waste_steps = 0
+    refill_min = max(1, int(refill_frac * B))
+
+    while True:
+        # One combined transfer: two separate np.asarray calls would each
+        # block on the device stream (two syncs per chunk on the hot loop).
+        done, n_em = jax.device_get((state.done, state.n_emitted))
+        for s in range(B):
+            if slot_trial[s] >= 0 and done[s]:
+                ti = int(slot_trial[s])
+                toks = np.concatenate(bufs[s]) if bufs[s] else np.zeros(0, np.int32)
+                results[ti] = toks[: int(n_em[s])]
+                slot_trial[s] = -1
+                bufs[s] = []
+        free = np.flatnonzero(slot_trial < 0)
+        n_live = B - len(free)
+
+        if next_trial < N and (len(free) >= refill_min or n_live == 0):
+            take = min(len(free), N - next_trial)
+            sel = free[:take]
+            sfx = np.zeros((B, Ss), np.int32)
+            msk = np.zeros((B, Ss), np.int32)
+            lay = np.zeros(B, np.int32)
+            stg = np.zeros(B, np.float32)
+            vec = np.zeros((B, H), np.float32)
+            sta = np.zeros(B, np.int32)
+            bud = np.ones(B, np.int32)
+            kd = np.zeros((B, 2), np.uint32)
+            rm = np.zeros(B, bool)
+            for j, s in enumerate(sel):
+                t = trials[next_trial + j]
+                rm[s] = True
+                sfx[s] = t.suffix_ids
+                msk[s] = t.suffix_mask
+                lay[s] = t.steer_layer
+                stg[s] = t.steer_strength
+                vec[s] = t.steer_vector
+                sta[s] = t.steer_start
+                bud[s] = t.budget
+                kd[s] = trial_keydata[next_trial + j]
+                slot_trial[s] = next_trial + j
+            cache, state, tok0 = scheduler_refill(
+                params, cfg, cache, state, spec,
+                jnp.asarray(sfx), jnp.asarray(msk), jnp.asarray(rm),
+                jnp.asarray(lay), jnp.asarray(stg), jnp.asarray(vec),
+                jnp.asarray(sta), jnp.asarray(bud), jnp.asarray(kd),
+            )
+            tok0 = np.asarray(tok0)
+            for s in sel:
+                bufs[s] = [tok0[s : s + 1]]
+            next_trial += take
+            refills += 1
+            # Loop back to harvest trials that finished at their first
+            # token (EOS / budget 1 / stop hit) before burning a chunk.
+            continue
+
+        if n_live == 0:
+            break  # queue drained, machine empty
+
+        page = jnp.int32(g % n_chunks) if n_chunks else jnp.int32(0)
+        cache, state, toks = scheduler_decode_chunk(
+            params, cfg, cache, state, spec, page, ch=ch
+        )
+        g += 1
+        toks = np.asarray(toks)
+        for s in range(B):
+            if slot_trial[s] >= 0:
+                bufs[s].append(toks[s])
+        occupancy_sum += n_live / B
+        waste_steps += (B - n_live) * ch
+        ledger.event(
+            "slot_occupancy",
+            chunk=g,
+            occupied=int(n_live),
+            slots=int(B),
+            frac=round(n_live / B, 4),
+            padded_waste_steps_total=int(waste_steps),
+        )
+
+    assert all(r is not None for r in results)
+    stats = {
+        "chunks": g,
+        "refills": refills,
+        "mean_slot_occupancy": round(occupancy_sum / g, 4) if g else 1.0,
+        "padded_row_waste_steps": int(waste_steps),
+    }
+    return results, stats

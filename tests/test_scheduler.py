@@ -1,0 +1,264 @@
+"""Continuous-batching slot scheduler: bit-equivalence with the fixed-batch
+paths (full prefill AND shared-prefix prefill), per-trial budgets, slot-count
+and chunk-size invariance, filler-row semantics, and the batch fallback."""
+
+import jax
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.models import (
+    ByteTokenizer,
+    init_params,
+    tiny_config,
+)
+from introspective_awareness_tpu.runtime import ModelRunner
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def runner(setup):
+    cfg, params = setup
+    return ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4,
+    )
+
+
+@pytest.fixture(scope="module")
+def runner_noprefix(setup):
+    """Same weights, shared-prefix path disabled: generate_batch_* here runs
+    the full-prefill ``generate_tokens`` executable."""
+    cfg, params = setup
+    return ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4, prefix_cache=False,
+    )
+
+
+COMMON = "The quick brown fox jumps over the lazy dog. " * 4
+
+
+def _queue(n, hidden):
+    """n trials sharing the preamble, ragged suffixes, a strength-0 row every
+    third trial, and steer starts landing INSIDE the padded suffix."""
+    prompts, starts, strengths, layers = [], [], [], []
+    for i in range(n):
+        p = (
+            COMMON
+            + f"Trial {i + 1}: Do you detect an injected thought"
+            + "?" * (i % 3 + 1)
+        )
+        prompts.append(p)
+        if i % 3 == 2:
+            strengths.append(0.0)
+            starts.append(None)  # strength-0 rows steer nowhere
+        else:
+            strengths.append(6.0 + i)
+            starts.append(len(p) - 10)  # byte tokenizer: chars == tokens
+        layers.append(1 + i % 2)
+    rng = np.random.default_rng(7)
+    vecs = [rng.standard_normal(hidden).astype(np.float32) * 4.0
+            for _ in range(n)]
+    return prompts, layers, vecs, strengths, starts
+
+
+def test_scheduler_matches_batch_and_prefix_paths(runner, runner_noprefix):
+    """One queue, three executables, one answer: the slot scheduler, the
+    shared-prefix batch path (generate_tokens_prefix), and the full-prefill
+    batch path (generate_tokens) must agree token-for-token at temp 0.
+
+    The queue is wider than the slot count (5 trials, 2 slots) so trials
+    cycle through refills, and includes strength-0 rows plus steer starts
+    inside the padded suffix — the operands satellite 3 calls out."""
+    prompts, layers, vecs, strengths, starts = _queue(5, runner.cfg.hidden_size)
+    kw = dict(
+        max_new_tokens=12, temperature=0.0,
+        steering_start_positions=starts, seed=0,
+    )
+    sched = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, slots=2, **kw
+    )
+    prefix = runner.generate_batch_with_grid_steering(
+        prompts, layers, vecs, strengths, **kw
+    )
+    full = runner_noprefix.generate_batch_with_grid_steering(
+        prompts, layers, vecs, strengths, **kw
+    )
+    assert sched == prefix == full
+
+
+def test_scheduler_mixed_budgets_match_grouped_references(runner):
+    """Per-trial budgets: every trial must equal the batch path run at
+    exactly that trial's budget (grouped by budget — the only way the fixed
+    path can express per-trial truncation without changing greedy text)."""
+    N = 8
+    prompts, layers, vecs, strengths, starts = _queue(N, runner.cfg.hidden_size)
+    budgets = [3, 12, 6, 12, 3, 8, 12, 5]
+    sched = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, max_new_tokens=12, temperature=0.0,
+        steering_start_positions=starts, budgets=budgets, seed=0, slots=3,
+    )
+    for b in sorted(set(budgets)):
+        idx = [i for i in range(N) if budgets[i] == b]
+        ref = runner.generate_batch_with_grid_steering(
+            [prompts[i] for i in idx], [layers[i] for i in idx],
+            [vecs[i] for i in idx], [strengths[i] for i in idx],
+            max_new_tokens=b, temperature=0.0,
+            steering_start_positions=[starts[i] for i in idx], seed=0,
+        )
+        for j, i in enumerate(idx):
+            assert sched[i] == ref[j], f"trial {i} (budget {b}) diverged"
+
+
+def test_scheduler_sampled_outputs_slot_invariant(runner):
+    """temp > 0: each trial samples from its own queue-indexed PRNG stream,
+    so the drawn text cannot depend on the slot count (which slot a trial
+    lands in, or who its neighbours are)."""
+    prompts, layers, vecs, strengths, starts = _queue(6, runner.cfg.hidden_size)
+    kw = dict(
+        max_new_tokens=10, temperature=0.9,
+        steering_start_positions=starts, seed=11,
+    )
+    two = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, slots=2, **kw
+    )
+    four = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, slots=4, **kw
+    )
+    assert two == four
+
+
+def test_scheduler_chunk_size_invariance(runner, monkeypatch):
+    """Scheduler output is invariant to the decode chunk size: ch=4 recycles
+    merged pages across many chunks, ch=16 packs the budget into few — an
+    execution detail that must not leak into greedy text."""
+    from introspective_awareness_tpu.runtime import generate as gen
+
+    prompts, layers, vecs, strengths, starts = _queue(5, runner.cfg.hidden_size)
+    budgets = [4, 12, 7, 12, 3]
+
+    def run():
+        return runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, max_new_tokens=12,
+            temperature=0.0, steering_start_positions=starts,
+            budgets=budgets, seed=0, slots=2,
+        )
+
+    monkeypatch.setattr(gen, "RING_CHUNK", 4)
+    fine = run()
+    monkeypatch.setattr(gen, "RING_CHUNK", 16)
+    coarse = run()
+    assert fine == coarse
+
+
+def test_grid_single_chunk_fast_path(runner_noprefix, monkeypatch):
+    """When the whole budget fits one chunk, generate skips the chunk
+    while_loop for a single fori_loop body; text must be unchanged vs the
+    multi-chunk plan."""
+    from introspective_awareness_tpu.runtime import generate as gen
+
+    prompts, layers, vecs, strengths, starts = _queue(
+        4, runner_noprefix.cfg.hidden_size
+    )
+    kw = dict(
+        max_new_tokens=20, temperature=0.0,
+        steering_start_positions=starts, seed=0,
+    )
+    monkeypatch.setattr(gen, "RING_CHUNK", 64)  # n_chunks == 1: fast path
+    one = runner_noprefix.generate_batch_with_grid_steering(
+        prompts, layers, vecs, strengths, **kw
+    )
+    monkeypatch.setattr(gen, "RING_CHUNK", 3)  # 7 chunks: while_loop path
+    many = runner_noprefix.generate_batch_with_grid_steering(
+        prompts, layers, vecs, strengths, **kw
+    )
+    assert one == many
+
+
+def test_filler_rows_emit_only_pad(runner_noprefix, monkeypatch):
+    """Batch-filler rows (padding B up to batch_multiple) are forced done at
+    step 0 via GenSpec.live: at the device level the filler row's entire
+    token slab must be pad, so it never gates the all-rows EOS early exit."""
+    import introspective_awareness_tpu.runtime.runner as rm
+
+    captured = {}
+    orig = rm.generate_tokens
+
+    def spy(*a, **k):
+        out = orig(*a, **k)
+        captured["tokens"] = np.asarray(out)
+        return out
+
+    monkeypatch.setattr(rm, "generate_tokens", spy)
+    prompts = ["Alpha one", "Beta two two", "Gamma three three three"]
+    out = runner_noprefix.generate_batch(
+        prompts, max_new_tokens=8, temperature=0.0
+    )
+    assert len(out) == 3
+    toks = captured["tokens"]
+    assert toks.shape[0] == 4  # padded to batch_multiple
+    pad = runner_noprefix.tokenizer.pad_id
+    assert (toks[3] == pad).all(), "filler row decoded real tokens"
+
+
+def test_scheduler_fallback_is_batch_path(runner):
+    """No shared prefix => the continuous path falls back to fixed batches:
+    uniform budgets produce the batch path's exact output, and a
+    mixed-budget queue (inexpressible per-batch) raises."""
+    prompts = ["Alpha prompt one", "Beta prompt two", "Gamma prompt three"]
+    rng = np.random.default_rng(3)
+    vecs = [rng.standard_normal(runner.cfg.hidden_size).astype(np.float32)
+            for _ in prompts]
+    layers = [1, 2, 1]
+    strengths = [5.0, 6.0, 7.0]
+    sched = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, max_new_tokens=8, temperature=0.0,
+        seed=0, slots=2,
+    )
+    ref = []
+    for i in range(0, 3, 2):  # fallback chunks the queue slot-wise
+        ref.extend(runner.generate_batch_with_grid_steering(
+            prompts[i:i + 2], layers[i:i + 2], vecs[i:i + 2],
+            strengths[i:i + 2], max_new_tokens=8, temperature=0.0, seed=0,
+        ))
+    assert sched == ref
+    with pytest.raises(ValueError, match="non-uniform"):
+        runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, max_new_tokens=8,
+            temperature=0.0, budgets=[2, 8, 8], seed=0, slots=2,
+        )
+
+
+def test_run_grid_pass_continuous_matches_batch(runner):
+    """Protocol level: run_grid_pass(scheduler='continuous') returns the
+    same result dicts (response text, provenance fields, task order) as the
+    legacy batch scheduler at temp 0."""
+    from introspective_awareness_tpu.protocol.trials import run_grid_pass
+
+    tasks = [
+        ("ocean", t, 0.5, 1 + (t % 2), float(2 * s))
+        for t in range(1, 4)
+        for s in range(1, 3)
+    ]
+    rng = np.random.default_rng(5)
+    vec = rng.standard_normal(runner.cfg.hidden_size).astype(np.float32)
+
+    def lookup(_lf, _concept):
+        return vec
+
+    kw = dict(
+        max_new_tokens=10, temperature=0.0, batch_size=2, seed=3,
+    )
+    batch = run_grid_pass(
+        runner, "injection", tasks, lookup, scheduler="batch", **kw
+    )
+    cont = run_grid_pass(
+        runner, "injection", tasks, lookup, scheduler="continuous", **kw
+    )
+    assert cont == batch
