@@ -323,6 +323,146 @@ def _sched_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     return r
 
 
+def _pipeline_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
+    """Pipelined vs synchronous scheduler host loop on the same queue shape
+    as ``_sched_compare`` (mixed budgets, 5 short : 1 long).
+
+    Both runs drain the identical trial queue through identical executables
+    and grade every trial with the same stub judge client (canned verdicts,
+    API-shaped latency). The sync leg is the pre-pipeline shape: land every
+    dispatch before the next, then grade the whole batch post-hoc. The
+    pipelined leg keeps one decode chunk in flight and streams finished
+    trials into a ``StreamingGradePool`` so grading runs concurrently with
+    decode; only the grading tail past the last harvest is exposed. Decode
+    outputs must be bit-identical (greedy) — the end-to-end speedup is
+    reported only alongside that check.
+
+    Gauges come from the scheduler's ledger span: ``bubble_frac`` is the
+    fraction of the sync loop's wall clock the device provably idled (the
+    bubble pipelining attacks); the pipelined run's own bubble shows what
+    remains. On a single-device CPU host the decode chunks themselves
+    serialize either way (``decode_only`` makes that visible), so the
+    end-to-end win comes from hiding grading latency inside the decode
+    window — ``grading_overlap_frac`` reports how much of it hid.
+    """
+    import time as _time
+
+    from introspective_awareness_tpu.judge import LLMJudge, StreamingGradePool
+    from introspective_awareness_tpu.judge.judge import reconstruct_trial_prompts
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    # Same dedicated section-runner config as _sched_compare: identical jit
+    # cache keys, so the executables are already compiled and warm.
+    runner = ModelRunner(
+        runner.params, cfg, tok, model_name="bench-pipe",
+        seq_multiple=16, batch_multiple=slots, ledger=ledger,
+    )
+    N = 3 * slots
+    sched_max = max(max_new, 256)
+    prompts, vecs, starts = _build_workload(cfg, tok, N)
+    layers = [int(cfg.n_layers * 0.6)] * N
+    strengths = [4.0] * N
+    cyc = [max(2, sched_max // 8)] * 5 + [sched_max]
+    budgets = [cyc[i % len(cyc)] for i in range(N)]
+
+    def run(pipe, cb=None):
+        return runner.generate_grid_scheduled(
+            prompts, layers, list(vecs), strengths, max_new_tokens=sched_max,
+            temperature=0.0, steering_start_positions=starts,
+            budgets=budgets, seed=0, slots=slots, refill_frac=0.5,
+            pipeline=pipe, result_cb=cb,
+        )
+
+    def span_gauges():
+        spans = [
+            e for e in ledger.events
+            if e.get("ev") == "span" and e.get("phase") == "generate_scheduled"
+        ]
+        return spans[-1] if spans else {}
+
+    class _StubJudgeClient:
+        """Canned grader with API-shaped latency; grading correctness is
+        judge-module territory — this measures only overlap."""
+
+        model_name = "bench-stub-judge"
+        overlap_safe = True
+
+        def grade(self, ps):
+            # 50 ms per graded row — far below a real judge API's ~1 s/row,
+            # so the overlap win reported here is a conservative floor.
+            _time.sleep(0.05 * len(ps))
+            return ["Answer: NO"] * len(ps)
+
+    judge = LLMJudge(client=_StubJudgeClient())
+
+    def trial_result(i, text):
+        return {
+            "concept": "bench", "trial": i + 1, "response": text,
+            "trial_type": "injection",
+        }
+
+    run(False)
+    run(True)  # warm both loop variants
+
+    # Sync leg: decode everything, then grade the whole batch post-hoc.
+    t0 = _time.perf_counter()
+    sync_out = run(False)
+    t_sync_decode = _time.perf_counter() - t0
+    g_sync = span_gauges()
+    results = [trial_result(i, r) for i, r in enumerate(sync_out)]
+    judge._evaluate_batch_inner(results, reconstruct_trial_prompts(results))
+    t_sync = _time.perf_counter() - t0
+
+    # Pipelined leg: stream finished trials into the grade pool as the
+    # scheduler harvests them; only the post-decode grading tail is paid.
+    pool = StreamingGradePool(judge, max_workers=2)
+    t0 = _time.perf_counter()
+    pipe_out = run(True, lambda i, text: pool.submit(i, trial_result(i, text)))
+    decode_end = _time.perf_counter()
+    t_pipe_decode = decode_end - t0
+    g_pipe = span_gauges()
+    graded, gstats = pool.finish(decode_end=decode_end)
+    t_pipe = _time.perf_counter() - t0
+    identical = sync_out == pipe_out
+
+    r = {
+        "slots": slots,
+        "queue_trials": N,
+        "sync_time_s": round(t_sync, 3),
+        "pipelined_time_s": round(t_pipe, 3),
+        "speedup": round(t_sync / t_pipe, 3) if t_pipe > 0 else None,
+        "decode_only_s": {
+            "sync": round(t_sync_decode, 3),
+            "pipelined": round(t_pipe_decode, 3),
+        },
+        "outputs_identical": identical,
+        "bubble_frac": g_sync.get("bubble_frac"),
+        "bubble_frac_pipelined": g_pipe.get("bubble_frac"),
+        "device_idle_ms_per_chunk": {
+            "sync": g_sync.get("device_idle_ms_per_chunk"),
+            "pipelined": g_pipe.get("device_idle_ms_per_chunk"),
+        },
+        "host_wait_ms_per_chunk": {
+            "sync": g_sync.get("host_wait_ms_per_chunk"),
+            "pipelined": g_pipe.get("host_wait_ms_per_chunk"),
+        },
+        "max_inflight_depth": g_pipe.get("max_inflight_depth"),
+        "decode_chunks": {
+            "sync": g_sync.get("chunks"), "pipelined": g_pipe.get("chunks"),
+        },
+        "grading_overlap_frac": gstats.get("grading_overlap_frac"),
+        "graded_streamed": len(graded),
+    }
+    log(
+        f"  [pipeline] {N} trials x {slots} slots: sync {t_sync:.2f}s "
+        f"(decode {t_sync_decode:.2f}s, bubble {r['bubble_frac']}) vs "
+        f"pipelined {t_pipe:.2f}s (decode {t_pipe_decode:.2f}s, bubble "
+        f"{r['bubble_frac_pipelined']}) -> {r['speedup']}x, "
+        f"identical={identical}, grading overlap={r['grading_overlap_frac']}"
+    )
+    return r
+
+
 def _hbm_model(runner, cfg, batch, prompt_len, max_new) -> float:
     """Modeled HBM bytes read per decode step: every parameter once + the
     full KV-cache buffer (the decode attention reads all T slots each step
@@ -437,6 +577,9 @@ def main() -> None:
 
     # ---- continuous scheduler vs fixed batches on a mixed-budget queue -----
     sched = _sched_compare(runner, cfg, tok, batches[0], max_new, ledger)
+
+    # ---- pipelined vs synchronous host loop + grading overlap --------------
+    pipe = _pipeline_compare(runner, cfg, tok, batches[0], max_new, ledger)
 
     # ---- int8 weight-quantized variant at the best bf16 batch --------------
     if on_tpu:
@@ -624,6 +767,7 @@ def main() -> None:
         ],
         "token_stats": stats,
         "scheduler": sched,
+        "pipeline": pipe,
         "phases": ledger.summary().get("phases", {}),
         "hbm_preflight": preflight_verdict,
         "hbm_devices": hbm_devices,

@@ -260,6 +260,24 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
         and cell_task_max < args.batch_size
     )
 
+    # Stream finished trials into judge grading while decode continues: the
+    # pipelined scheduler surfaces each trial the moment it finalizes, and a
+    # bounded worker pool grades concurrently — but only for clients that can
+    # safely run off-thread during decode (the on-device grader shares the
+    # subject's chips and opts out via overlap_safe=False).
+    stream_grading = (
+        judge is not None
+        and args.scheduler == "continuous"
+        and getattr(judge.client, "overlap_safe", True)
+    )
+
+    def _make_pool():
+        if not stream_grading:
+            return None
+        from introspective_awareness_tpu.judge import StreamingGradePool
+
+        return StreamingGradePool(judge)
+
     if pending and fuse:
         # ---- fused: rows of ALL pending cells pack into shared batches ----
         # Layer index and strength are per-example runtime operands, so the
@@ -292,7 +310,7 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 runner, trial_type, tasks, vector_lookup,
                 max_new_tokens=args.max_tokens, temperature=args.temperature,
                 batch_size=args.batch_size, seed=args.seed + k * 1_000_003,
-                scheduler=args.scheduler,
+                scheduler=args.scheduler, grade_pool=_make_pool(),
             )
             fused += out
             # Pass-granular timings: the fused grid has no per-cell unit of
@@ -317,7 +335,10 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             results = by_cell.get((lf, strength), [])
             layer_idx = get_layer_at_fraction(runner.n_layers, lf)
             cell_dir = config_dir(args.output_dir, model_name, lf, strength)
-            metrics = _cell_metrics(results, judge, args, lf, layer_idx, strength)
+            metrics = _cell_metrics(
+                results, judge, args, lf, layer_idx, strength,
+                skip_graded=stream_grading,
+            )
             _save_cell(results, metrics, cell_dir, model_name)
             all_results[(lf, strength)] = {"results": results, **metrics}
             _print_cell(lf, strength, metrics)
@@ -341,14 +362,20 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             results = []
             for trial_type, trial_nums in trial_plan:
                 tasks = [(c, t) for c in args.concepts for t in trial_nums]
-                results += run_trial_pass(runner, trial_type, tasks, **common)
+                results += run_trial_pass(
+                    runner, trial_type, tasks,
+                    grade_pool=_make_pool(), **common,
+                )
             t_cell = time.perf_counter() - t0
             t_gen += t_cell
             n_generated += len(results)
             cell_times.append(round(t_cell, 3))
             cell_counts.append(len(results))
 
-            metrics = _cell_metrics(results, judge, args, lf, layer_idx, strength)
+            metrics = _cell_metrics(
+                results, judge, args, lf, layer_idx, strength,
+                skip_graded=stream_grading,
+            )
             _save_cell(results, metrics, cell_dir, model_name)
             all_results[(lf, strength)] = {"results": results, **metrics}
             _print_cell(lf, strength, metrics)
@@ -405,17 +432,34 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
     return all_results
 
 
-def _cell_metrics(results, judge, args, lf, layer_idx, strength) -> dict:
-    """Judge metrics with keyword fallback (reference :2064-2122)."""
+def _cell_metrics(
+    results, judge, args, lf, layer_idx, strength, skip_graded=False
+) -> dict:
+    """Judge metrics with keyword fallback (reference :2064-2122).
+
+    ``skip_graded=True`` (streaming-grading runs) judges only rows without
+    an ``evaluations`` entry — the streaming pool already graded the rest
+    during decode; re-judge paths leave it False to force re-evaluation.
+    """
     from introspective_awareness_tpu.obs import NullLedger
 
     ledger = getattr(args, "_ledger", None) or NullLedger()
     if judge is not None:
         try:
-            evaluated = judge.evaluate_batch(
-                results, reconstruct_trial_prompts(results)
-            )
-            results[:] = evaluated
+            if skip_graded:
+                todo = [
+                    i for i, r in enumerate(results) if "evaluations" not in r
+                ]
+            else:
+                todo = list(range(len(results)))
+            if todo:
+                sub = [results[i] for i in todo]
+                evaluated = judge.evaluate_batch(
+                    sub, reconstruct_trial_prompts(sub)
+                )
+                for i, ev in zip(todo, evaluated):
+                    results[i] = ev
+            evaluated = list(results)
             with ledger.span("grade", evals=len(evaluated), cell=f"{lf}/{strength}"):
                 metrics = compute_detection_and_identification_metrics(evaluated)
             metrics["metrics_source"] = "judge"

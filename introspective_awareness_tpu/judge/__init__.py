@@ -32,6 +32,7 @@ from introspective_awareness_tpu.judge.judge import (
     batch_evaluate,
     reconstruct_trial_prompts,
 )
+from introspective_awareness_tpu.judge.streaming import StreamingGradePool
 
 __all__ = [
     "AFFIRMATIVE_RESPONSE_CRITERIA",
@@ -48,6 +49,7 @@ __all__ = [
     "parse_grade",
     "parse_yes_no",
     "LLMJudge",
+    "StreamingGradePool",
     "batch_evaluate",
     "reconstruct_trial_prompts",
 ]

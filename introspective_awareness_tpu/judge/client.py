@@ -56,6 +56,10 @@ class OpenAIJudgeClient:
     batch runs on a fresh event loop with a fresh client.
     """
 
+    # Fresh event loop + HTTP client per grade() call: safe to drive from
+    # judge.streaming worker threads while the TPU decodes.
+    overlap_safe = True
+
     def __init__(
         self,
         model: str = "gpt-4.1-nano",
@@ -159,6 +163,11 @@ class OnDeviceJudgeClient:
     # decode loop stops there (GenSpec.stop_seqs). parse_yes_no reads
     # "Answer: X" wherever it appears, so truncating after it is lossless.
     STOP_STRINGS = ("Answer: YES", "Answer: NO")
+    # Grading generates on the SAME chips the subject's scheduler is
+    # driving — streaming it concurrently with decode would contend for the
+    # device (and call jit from a second thread mid-dispatch). The
+    # streaming grade pool must not be built around this client.
+    overlap_safe = False
     # criteria.render("prefix-cached"): the whole (verbatim) criteria text
     # becomes a shared token prefix, so the runner's shared-prefix KV cache
     # prefills it once per grading batch instead of once per row.

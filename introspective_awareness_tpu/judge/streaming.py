@@ -1,0 +1,157 @@
+"""Stream finished trials into judge grading while decode continues.
+
+The synchronous sweep grades a cell only after its whole queue drains:
+generate → (TPU idle) → judge. With the pipelined scheduler surfacing
+trials the moment their flags land (``result_cb``), grading can start
+while later chunks still decode. :class:`StreamingGradePool` is the
+bounded host worker pool between the two: the scheduler thread ``submit``s
+finished results (already detokenized — that happens in the runner's
+callback, also overlapped), worker threads micro-batch them through the
+judge's two-stage flow, and ``finish`` joins everything and reports how
+much grading wall time genuinely overlapped decode.
+
+Threading contract:
+
+- Workers call ``LLMJudge._evaluate_batch_inner`` directly — the span-free
+  inner flow — because the run ledger is not thread-safe; the caller emits
+  one ``grading_overlap`` event from its own thread instead.
+- ``OpenAIJudgeClient.grade`` spins a fresh event loop + client per batch,
+  so concurrent calls from worker threads are independent.
+- ``OnDeviceJudgeClient`` is *not* overlap-safe: it generates on the same
+  chips (and jit machinery) the scheduler is driving. It carries
+  ``overlap_safe = False`` and callers must not build a pool around it —
+  check ``getattr(judge.client, "overlap_safe", True)``.
+- A worker failure (API down, parse explosion) marks its items ungraded
+  and the pool keeps running; callers fall back to post-hoc grading for
+  whatever ``finish`` returns without an ``evaluations`` entry.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+from introspective_awareness_tpu.judge.judge import (
+    LLMJudge,
+    reconstruct_trial_prompts,
+)
+
+_STOP = object()
+
+
+class StreamingGradePool:
+    """Bounded worker pool grading a stream of finished trial results.
+
+    ``submit(queue_index, result)`` is called from the scheduler thread as
+    trials finalize; ``finish(decode_end=...)`` drains the queue, joins the
+    workers, and returns ``(graded, stats)`` where ``graded`` maps queue
+    index → result-with-``evaluations`` (order restoration is the caller's
+    one-liner: iterate indices in queue order) and ``stats`` quantifies the
+    decode/grading overlap. Single-use: one pool per scheduler run.
+    """
+
+    def __init__(
+        self, judge: LLMJudge, max_workers: int = 4, max_batch: int = 8
+    ):
+        self.judge = judge
+        self.max_batch = max(1, int(max_batch))
+        self._q: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+        self._graded: dict[int, dict] = {}
+        self._windows: list[tuple[float, float]] = []  # per-batch (t0, t1)
+        self._errors: list[str] = []
+        self._submitted = 0
+        self._finished = False
+        self._workers = [
+            threading.Thread(target=self._worker, daemon=True)
+            for _ in range(max(1, int(max_workers)))
+        ]
+        for w in self._workers:
+            w.start()
+
+    # -- producer side (scheduler thread) -----------------------------------
+
+    def submit(self, idx: int, result: dict) -> None:
+        """Queue one finished trial result (must carry ``response``,
+        ``concept``, ``trial``, ``trial_type`` — the fields the two-stage
+        judge flow and prompt reconstruction read)."""
+        if self._finished:
+            raise RuntimeError("StreamingGradePool already finished")
+        self._submitted += 1
+        self._q.put((idx, result))
+
+    # -- worker side --------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            batch = [item]
+            # Micro-batch whatever else is already waiting: one API
+            # round-trip for several trials without holding early finishers
+            # hostage to a full batch.
+            while len(batch) < self.max_batch:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    self._q.put(_STOP)  # hand the sentinel to a sibling
+                    break
+                batch.append(nxt)
+            idxs = [i for i, _ in batch]
+            results = [r for _, r in batch]
+            t0 = time.perf_counter()
+            try:
+                evaluated = self.judge._evaluate_batch_inner(
+                    results, reconstruct_trial_prompts(results)
+                )
+            except Exception as e:  # noqa: BLE001 - degrade to post-hoc
+                with self._lock:
+                    self._errors.append(f"{type(e).__name__}: {e}")
+                continue
+            t1 = time.perf_counter()
+            with self._lock:
+                self._windows.append((t0, t1))
+                for i, ev in zip(idxs, evaluated):
+                    self._graded[i] = ev
+
+    # -- join ----------------------------------------------------------------
+
+    def finish(
+        self, decode_end: Optional[float] = None
+    ) -> tuple[dict[int, dict], dict]:
+        """Post stop sentinels, join workers, return graded map + overlap
+        stats. ``decode_end`` is the ``time.perf_counter()`` instant decode
+        finished; grading busy-time before it counts as overlapped."""
+        self._finished = True
+        for _ in self._workers:
+            self._q.put(_STOP)
+        for w in self._workers:
+            w.join()
+        busy = sum(t1 - t0 for t0, t1 in self._windows)
+        overlap = None
+        if decode_end is not None:
+            overlap = sum(
+                max(0.0, min(t1, decode_end) - t0)
+                for t0, t1 in self._windows
+                if t0 < decode_end
+            )
+        stats = {
+            "submitted": self._submitted,
+            "graded": len(self._graded),
+            "grade_batches": len(self._windows),
+            "grade_busy_s": round(busy, 4),
+            "grade_overlap_s": (
+                None if overlap is None else round(overlap, 4)
+            ),
+            "grading_overlap_frac": (
+                None if overlap is None or busy <= 0
+                else round(overlap / busy, 4)
+            ),
+            "grade_errors": list(self._errors),
+        }
+        return self._graded, stats

@@ -11,6 +11,9 @@ Four pieces, threaded through runner / sweep / judge / bench / scripts:
   freshly-jitted executable runs; fails fast naming the largest temps.
 - :mod:`~introspective_awareness_tpu.obs.compile_stats` — persistent-cache
   hit/miss counters and per-executable compile seconds for manifests.
+- :mod:`~introspective_awareness_tpu.obs.pipeline` — overlap gauges for the
+  software-pipelined scheduler loop: host-wait vs device-idle ms per chunk,
+  in-flight depth, bubble fraction.
 - :mod:`~introspective_awareness_tpu.obs.timing` — the original wall-timer
   registry, profiler capture, and NaN/Inf sanitizers (promoted from
   ``utils/observability.py``, which still re-exports for back-compat).
@@ -24,6 +27,7 @@ from introspective_awareness_tpu.obs.ledger import (
     Span,
     load_ledger,
 )
+from introspective_awareness_tpu.obs.pipeline import PipelineGauges
 from introspective_awareness_tpu.obs.preflight import (
     HbmPreflightError,
     PreflightReport,
@@ -44,6 +48,7 @@ __all__ = [
     "HbmPreflightError",
     "NullLedger",
     "PHASES",
+    "PipelineGauges",
     "PreflightReport",
     "RunLedger",
     "Span",

@@ -1,0 +1,76 @@
+"""Pipeline gauges for the software-pipelined scheduler host loop.
+
+The continuous-batching host loop overlaps decode-chunk execution with
+harvest/refill bookkeeping (runtime.scheduler).  These gauges quantify how
+well that overlap works, per run:
+
+- ``host_wait_s`` — host time spent blocked on device->host transfers
+  (landing a chunk's ``done``/``n_emitted`` flags or its token slab).  In
+  the synchronous loop this is the full chunk execution time; pipelined, it
+  collapses toward zero because the copy was started at dispatch and lands
+  while the *next* chunk executes.
+- ``device_idle_s`` — host time that elapsed while **nothing** was in
+  flight on the device: every dispatched op had already had its results
+  landed, so the device provably sat idle while the host ran Python
+  (harvest loops, refill array packing, ledger writes, jit dispatch).
+  This is the bubble the pipelined loop hides.
+- ``max_inflight_depth`` — high-water mark of dispatched-but-unprocessed
+  ops (1 decode chunk + any refills queued behind it).
+
+``bubble_frac = device_idle_s / wall_s`` is the headline number:
+the fraction of the scheduler's wall clock the accelerator spent waiting
+for the host.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class PipelineGauges:
+    """Accumulates overlap gauges for one scheduler run (host-side clocks)."""
+
+    host_wait_s: float = 0.0
+    device_idle_s: float = 0.0
+    max_inflight_depth: int = 0
+    _idle_from: Optional[float] = field(default=None, repr=False)
+
+    # -- recording ----------------------------------------------------------
+
+    def idle_start(self) -> None:
+        """The in-flight queue just drained: the device is provably idle."""
+        if self._idle_from is None:
+            self._idle_from = time.perf_counter()
+
+    def dispatched(self, inflight_depth: int) -> None:
+        """An op was just dispatched; any open idle window closes here."""
+        if self._idle_from is not None:
+            self.device_idle_s += time.perf_counter() - self._idle_from
+            self._idle_from = None
+        if inflight_depth > self.max_inflight_depth:
+            self.max_inflight_depth = inflight_depth
+
+    def waited(self, seconds: float) -> None:
+        """Host blocked ``seconds`` landing device results."""
+        self.host_wait_s += seconds
+
+    # -- reporting ----------------------------------------------------------
+
+    def as_stats(self, wall_s: float, chunks: int) -> dict:
+        """Ledger/bench-facing snapshot (ms per chunk + bubble fraction)."""
+        per = max(chunks, 1)
+        return {
+            "host_wait_ms": round(1e3 * self.host_wait_s, 3),
+            "host_wait_ms_per_chunk": round(1e3 * self.host_wait_s / per, 4),
+            "device_idle_ms": round(1e3 * self.device_idle_s, 3),
+            "device_idle_ms_per_chunk": round(
+                1e3 * self.device_idle_s / per, 4
+            ),
+            "bubble_frac": (
+                round(self.device_idle_s / wall_s, 4) if wall_s > 0 else 0.0
+            ),
+            "max_inflight_depth": int(self.max_inflight_depth),
+        }

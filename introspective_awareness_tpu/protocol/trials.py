@@ -9,6 +9,7 @@ strength 0 on the same compiled executable.
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -33,6 +34,7 @@ def run_trial_pass(
     seed: Optional[int] = None,
     debug: bool = False,
     scheduler: str = "batch",
+    grade_pool=None,
 ) -> list[dict]:
     """One batched pass of a trial type over (concept, trial) tasks.
 
@@ -60,6 +62,7 @@ def run_trial_pass(
             lambda _lf, c: vectors[c],
             max_new_tokens=max_new_tokens, temperature=temperature,
             batch_size=batch_size, seed=seed, scheduler="continuous",
+            grade_pool=grade_pool,
         )
     if scheduler != "batch":
         raise ValueError(f"unknown scheduler {scheduler!r}")
@@ -124,6 +127,7 @@ def run_grid_pass(
     batch_size: int = 256,
     seed: Optional[int] = None,
     scheduler: str = "batch",
+    grade_pool=None,
 ) -> list[dict]:
     """One batched pass where every row may belong to a DIFFERENT
     (layer, strength) cell — the fused-sweep path.
@@ -138,6 +142,13 @@ def run_grid_pass(
     refilled with pending tasks instead of waiting out a fixed batch, so no
     cell pays for another cell's ragged tail. Cell provenance is positional
     — results come back in task order either way.
+
+    ``grade_pool`` (a ``judge.StreamingGradePool``; continuous scheduler
+    only) streams each trial's result dict into judge grading the moment
+    the scheduler finalizes it, overlapping grading with ongoing decode.
+    The returned list is still in task order, with ``evaluations`` attached
+    wherever the pool graded in time; rows the pool missed (worker error)
+    come back ungraded for the caller's post-hoc fallback.
     """
     if trial_type not in TRIAL_TYPES:
         raise ValueError(f"unknown trial_type {trial_type!r} (expected {TRIAL_TYPES})")
@@ -163,19 +174,10 @@ def run_grid_pass(
             vecs.append(np.asarray(vector_lookup(lf, concept), np.float32))
             layers.append(layer_idx)
             strengths.append(strength if injected else 0.0)
-        responses = runner.generate_grid_scheduled(
-            prompts,
-            layer_indices=layers,
-            steering_vectors=vecs,
-            strengths=strengths,
-            max_new_tokens=max_new_tokens,
-            temperature=temperature,
-            steering_start_positions=starts,
-            seed=seed,
-            slots=batch_size,
-        )
-        return [
-            {
+
+        def make_result(i: int, response: str) -> dict:
+            concept, trial_num, lf, layer_idx, strength = tasks[i]
+            return {
                 "concept": concept,
                 "trial": trial_num,
                 "response": response,
@@ -186,8 +188,38 @@ def run_grid_pass(
                 "detected": check_concept_mentioned(response, concept),
                 "trial_type": trial_type,
             }
-            for (concept, trial_num, lf, layer_idx, strength), response
-            in zip(tasks, responses)
+
+        streamed: dict[int, dict] = {}
+        result_cb = None
+        if grade_pool is not None:
+            def result_cb(i: int, response: str) -> None:
+                r = make_result(i, response)
+                streamed[i] = r
+                grade_pool.submit(i, r)
+
+        responses = runner.generate_grid_scheduled(
+            prompts,
+            layer_indices=layers,
+            steering_vectors=vecs,
+            strengths=strengths,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            steering_start_positions=starts,
+            seed=seed,
+            slots=batch_size,
+            result_cb=result_cb,
+        )
+        if grade_pool is None:
+            return [make_result(i, r) for i, r in enumerate(responses)]
+        # Join the grading workers and restore queue order: graded where the
+        # pool finished, the streamed (ungraded) dict where it didn't.
+        graded, gstats = grade_pool.finish(decode_end=time.perf_counter())
+        ledger = getattr(runner, "ledger", None)
+        if ledger is not None:
+            ledger.event("grading_overlap", trials=len(tasks), **gstats)
+        return [
+            graded.get(i, streamed.get(i) or make_result(i, responses[i]))
+            for i in range(len(tasks))
         ]
 
     results: list[dict] = []

@@ -234,12 +234,16 @@ def _sample_and_decode(
     return tokens[:, :max_new_tokens]
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "sp_mesh"))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "sp_mesh"),
+    donate_argnames=("ids", "mask"),
+)
 def generate_tokens(
     params: dict,
     cfg: ModelConfig,
-    ids: jax.Array,  # [B, S] left-padded
-    mask: jax.Array,  # [B, S]
+    ids: jax.Array,  # [B, S] left-padded — DONATED (pass host arrays to reuse)
+    mask: jax.Array,  # [B, S] — DONATED
     spec: GenSpec,
     *,
     max_new_tokens: int,
@@ -276,13 +280,17 @@ def generate_tokens(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens"),
+    donate_argnames=("suffix_ids", "suffix_mask"),
+)
 def generate_tokens_prefix(
     params: dict,
     cfg: ModelConfig,
     prefix_ids: jax.Array,  # [P0] — the SHARED unpadded prompt prefix
-    suffix_ids: jax.Array,  # [B, Ss] — left-padded per-row suffixes
-    suffix_mask: jax.Array,  # [B, Ss]
+    suffix_ids: jax.Array,  # [B, Ss] — left-padded per-row suffixes; DONATED
+    suffix_mask: jax.Array,  # [B, Ss] — DONATED
     spec: GenSpec,  # steer_start in PADDED SUFFIX coords
     *,
     max_new_tokens: int,
@@ -558,7 +566,14 @@ def scheduler_refill(
     their outputs are discarded — folds the fresh suffix KV into the slot
     tier for refilled rows only, and samples each new trial's first token.
     Must be called at a chunk boundary (ring folded, ``rlen == 0``), which
-    the host loop guarantees."""
+    the host loop guarantees.
+
+    Returns ``(cache, state, tok0, flags)`` where ``flags`` packs the new
+    state's ``[done, n_emitted]`` as one ``[2B]`` int32 vector. It is a
+    *computed* output (not an alias of the donated state), so the host can
+    start a non-blocking D2H copy on it and keep it readable after ``state``
+    itself is donated into the next executable call — the pipelined loop's
+    harvest vehicle."""
     B, Ss = suffix_ids.shape
     L = cache.rk.shape[0]
     T = cache.k.shape[2]
@@ -629,7 +644,8 @@ def scheduler_refill(
         keydata=jnp.where(m[:, None], keydata, state.keydata),
         tail=new_tail,
     )
-    return cache, state, tok0
+    flags = jnp.concatenate([state.done.astype(jnp.int32), state.n_emitted])
+    return cache, state, tok0, flags
 
 
 @partial(
@@ -651,7 +667,9 @@ def scheduler_decode_chunk(
     they emit pad — so a chunk makes progress for exactly the live slots.
     The chunk is folded into the merged buffer at ``page`` (host passes the
     global chunk counter mod n_chunks). Returns the chunk's tokens
-    ``[B, ch]`` for host-side harvesting."""
+    ``[B, ch]`` plus a packed ``[done, n_emitted]`` ``flags`` vector ([2B]
+    int32, donation-safe — see ``scheduler_refill``) for host-side
+    harvesting."""
     B = state.prev.shape[0]
     steer_decode = SteerSpec(
         state.steer_layer,
@@ -694,4 +712,5 @@ def scheduler_decode_chunk(
     state = state._replace(
         prev=prev, done=done, n_emitted=n_emitted, keydata=keydata, tail=tail
     )
-    return cache, state, tokens
+    flags = jnp.concatenate([done.astype(jnp.int32), n_emitted])
+    return cache, state, tokens, flags

@@ -16,7 +16,7 @@ handful of executables regardless of ragged trial counts (SURVEY.md §7.4.2).
 from __future__ import annotations
 
 import gc
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -402,6 +402,9 @@ class ModelRunner:
                 else self._shard_batch(jnp.arange(Bp) < B)
             ),
         )
+        # ids/mask are donated into the generate executables below — take any
+        # host copies needed after the call now.
+        mask_host = np.asarray(mask) if debug else None
         if L0:
             fn = generate_tokens_prefix
             fn_args = (
@@ -441,7 +444,7 @@ class ModelRunner:
             ).sum()))
         if debug:
             steered_prompt = int(
-                ((np.arange(S)[None, :] >= starts[:B, None]) & (np.asarray(mask)[:B] > 0)).sum()
+                ((np.arange(S)[None, :] >= starts[:B, None]) & (mask_host[:B] > 0)).sum()
             )
             print(
                 f"[DEBUG] steered prompt positions={steered_prompt}, "
@@ -595,6 +598,8 @@ class ModelRunner:
         stop_strings: Optional[Sequence[str]] = None,
         slots: Optional[int] = None,
         refill_frac: float = 0.25,
+        pipeline: bool = True,
+        result_cb: Optional[Callable[[int, str], None]] = None,
         **kw,
     ) -> list[str]:
         """Continuous-batching counterpart of
@@ -603,6 +608,13 @@ class ModelRunner:
         (runtime.scheduler), so finished rows free capacity immediately
         instead of waiting out their batch. Per-trial ``budgets`` cap each
         row's generation (default: ``max_new_tokens`` for all).
+
+        ``pipeline`` keeps one decode chunk in flight (software-pipelined
+        host loop; output-identical — see runtime.scheduler). When
+        ``result_cb`` is given it receives ``(queue_index, decoded_text)``
+        the moment each trial finishes — while decode continues — so the
+        caller can stream finished trials into judge grading; the final
+        return value is still the full in-order list.
 
         Eligibility mirrors the shared-prefix path — every prompt must
         share a prefix no steered row steers inside (the sweep's preamble),
@@ -667,7 +679,7 @@ class ModelRunner:
                 )
             out: list[str] = []
             for i in range(0, N, slots):
-                out.extend(self.generate_batch_with_grid_steering(
+                batch = self.generate_batch_with_grid_steering(
                     prompts[i : i + slots],
                     list(layer_arr[i : i + slots]),
                     steering_vectors[i : i + slots],
@@ -680,7 +692,12 @@ class ModelRunner:
                     ),
                     seed=seed,
                     stop_strings=stop_strings,
-                ))
+                )
+                if result_cb is not None:
+                    # Stream at batch granularity (the finest this path has).
+                    for j, text in enumerate(batch):
+                        result_cb(i + j, text)
+                out.extend(batch)
             return out
 
         suffix_rows = [r[L0:] for r in rows]
@@ -712,6 +729,15 @@ class ModelRunner:
             self._calls += 1
             seed = self._seed * 1_000_003 + self._calls
         stop = self._stop_token_seqs(stop_strings) if stop_strings else None
+        texts: dict[int, str] = {}
+        tok_cb = None
+        if result_cb is not None:
+            def tok_cb(i: int, toks: np.ndarray) -> None:
+                # Detokenize on the scheduler thread while later chunks are
+                # still decoding on device; memoized so the in-order return
+                # below doesn't decode twice.
+                texts[i] = self._decode_row(toks)
+                result_cb(i, texts[i])
         with self.ledger.span(
             "generate_scheduled", trials=N, slots=slots, prefix_len=int(L0),
             suffix_len=int(Ss), max_new_tokens=int(max_new_tokens),
@@ -727,11 +753,15 @@ class ModelRunner:
                 stop_seqs=None if stop is None else np.asarray(stop),
                 seed=int(seed), refill_frac=refill_frac,
                 ledger=self.ledger,
+                pipeline=pipeline, result_cb=tok_cb,
             )
             span.add_evals(N)
             span.add_tokens(int(sum(len(r) for r in results)))
             span.set(**stats)
-        return [self._decode_row(r) for r in results]
+        return [
+            texts[i] if i in texts else self._decode_row(results[i])
+            for i in range(N)
+        ]
 
     # -- misc ---------------------------------------------------------------
 

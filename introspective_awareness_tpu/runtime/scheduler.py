@@ -12,22 +12,46 @@ steer layer/strength/vector/start, budget, and RNG are per-slot runtime
 operands, so the three executables compiled by ``runtime.generate``
 (init / refill / decode-chunk) serve the entire sweep.
 
-Host/device split: the device never blocks on the queue — each decode
-chunk returns its ``[B, ch]`` token slab plus per-slot done flags, the host
-harvests finished slots, and refills are batched (``refill_frac``) so the
-full-batch suffix pass amortizes across several admissions.
+Software pipelining (``pipeline=True``): the loop keeps one decode chunk
+always in flight. Chunk k+1 is dispatched immediately (JAX async dispatch)
+while chunk k's ``done``/``n_emitted`` flags and token slab travel
+device→host via a non-blocking copy started at dispatch time
+(``copy_to_host_async``); harvest/refill decisions are made from chunk
+k−1's already-landed flags. This is *output-identical* to the synchronous
+loop, not approximate:
+
+- chunk-granular EOS already tolerates dead steps inside a chunk — a slot
+  that finished during chunk k simply rides chunk k+1 masked done (attn 0,
+  emits pad, state frozen), exactly like an intra-chunk finish;
+- per-trial PRNG streams are queue-indexed (``fold_in(base_key, i)``),
+  never slot- or timing-dependent, so *when* a trial is admitted cannot
+  change what it samples;
+- harvest truncates each trial's buffer to the device-reported
+  ``n_emitted``, so extra dead-chunk pad rows never leak into results.
+
+The one-chunk lag can cost at most one speculative all-dead chunk per
+wave tail; a host-side budget horizon (``rem``) suppresses it whenever the
+remaining slots are provably budget-exhausted, so budget-forced queues
+match the synchronous loop's chunk/occupancy/waste stats exactly.
+
+Finished trials surface through ``result_cb`` the moment their flags land
+— while later chunks still decode — which is what lets the caller
+detokenize and fire judge requests concurrently with generation
+(``judge.streaming.StreamingGradePool``).
 """
 
 from __future__ import annotations
 
+import time
+from collections import deque
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import numpy as np
 
 from introspective_awareness_tpu.models.config import ModelConfig
-from introspective_awareness_tpu.obs import NullLedger
+from introspective_awareness_tpu.obs import NullLedger, PipelineGauges
 from introspective_awareness_tpu.runtime.generate import (
     SchedSpec,
     _chunk_plan,
@@ -57,6 +81,23 @@ class TrialRequest:
     budget: int
 
 
+@dataclass
+class _InFlight:
+    """One dispatched device op whose results are still travelling D2H.
+
+    ``flags``/``toks`` are *computed* jit outputs (never aliases of the
+    donated cache/state), so they stay readable after the state is donated
+    into the next executable call; their host copies were started at
+    dispatch. ``owners`` snapshots the slot→trial assignment at dispatch —
+    the only host state a later processing step needs to interpret the
+    per-slot rows."""
+
+    kind: str  # "chunk" | "refill"
+    flags: jax.Array  # [2B] int32 — packed [done, n_emitted]
+    toks: jax.Array  # chunk: [B, ch] token slab; refill: [B] tok0
+    owners: np.ndarray  # [B] queue index per slot at dispatch (-1 = free)
+
+
 def run_scheduled(
     params: dict,
     cfg: ModelConfig,
@@ -72,6 +113,8 @@ def run_scheduled(
     seed: int = 0,
     refill_frac: float = 0.25,
     ledger=None,
+    pipeline: bool = True,
+    result_cb: Optional[Callable[[int, np.ndarray], None]] = None,
 ) -> tuple[list[np.ndarray], dict]:
     """Drain ``trials`` through ``slots`` decode rows; returns per-trial
     token arrays (input order, length = tokens actually emitted, final
@@ -81,13 +124,20 @@ def run_scheduled(
     ``max(1, refill_frac * slots)`` slots are free, or the machine is idle —
     batching admissions amortizes the full-batch suffix pass that each
     refill costs.
+
+    ``pipeline=False`` processes every dispatch's results before the next
+    dispatch — the fully synchronous loop, kept for A/B benchmarking and
+    identity tests. ``result_cb(queue_index, tokens)`` fires the moment a
+    trial is finalized (possibly while decode continues); callbacks run on
+    the scheduler thread, so keep them cheap or hand off to a worker pool.
     """
     ledger = ledger if ledger is not None else NullLedger()
     B = slots
     N = len(trials)
     if N == 0:
         return [], {"chunks": 0, "refills": 0, "mean_slot_occupancy": 0.0,
-                    "padded_row_waste_steps": 0}
+                    "padded_row_waste_steps": 0, "pipelined": bool(pipeline),
+                    **PipelineGauges().as_stats(0.0, 0)}
     Ss = int(trials[0].suffix_ids.shape[0])
     H = int(trials[0].steer_vector.shape[0])
     for t in trials:
@@ -126,96 +176,179 @@ def run_scheduled(
     )
 
     slot_trial = np.full(B, -1, np.int64)  # queue index per slot, -1 = free
-    bufs: list[list[np.ndarray]] = [[] for _ in range(B)]
+    # Host-side remaining-step upper bound per slot: budget-1 at refill,
+    # minus ch per dispatched chunk. rem == 0 proves the slot's trial is
+    # budget-forced done by work already in flight (early EOS only makes it
+    # MORE done), so a chunk over all-rem==0 slots would be provably dead.
+    rem = np.zeros(B, np.int64)
+    bufs: list[list[np.ndarray]] = [[] for _ in range(N)]  # keyed by TRIAL
     results: list[Optional[np.ndarray]] = [None] * N
+    # done flags of the most recently PROCESSED event = device truth at the
+    # next processed chunk's dispatch boundary (events process in dispatch
+    # order). scheduler_init leaves every slot done, no transfer needed.
+    last_done = np.ones(B, bool)
+    pending: deque[_InFlight] = deque()
+    depth = 1 if pipeline else 0
+
     next_trial = 0
     g = 0  # global chunk counter (drives merged-page recycling)
     refills = 0
+    chunks_done = 0  # processed chunks (== g once the queue drains)
     occupancy_sum = 0.0
     waste_steps = 0
     refill_min = max(1, int(refill_frac * B))
+    gauges = PipelineGauges()
+    t_loop0 = time.perf_counter()
+    gauges.idle_start()  # nothing dispatched yet beyond init
 
-    while True:
-        # One combined transfer: two separate np.asarray calls would each
-        # block on the device stream (two syncs per chunk on the hot loop).
-        done, n_em = jax.device_get((state.done, state.n_emitted))
-        for s in range(B):
-            if slot_trial[s] >= 0 and done[s]:
-                ti = int(slot_trial[s])
-                toks = np.concatenate(bufs[s]) if bufs[s] else np.zeros(0, np.int32)
-                results[ti] = toks[: int(n_em[s])]
-                slot_trial[s] = -1
-                bufs[s] = []
+    def _dispatch_refill() -> None:
+        nonlocal cache, state, next_trial, refills
         free = np.flatnonzero(slot_trial < 0)
-        n_live = B - len(free)
+        take = min(len(free), N - next_trial)
+        sel = free[:take]
+        sfx = np.zeros((B, Ss), np.int32)
+        msk = np.zeros((B, Ss), np.int32)
+        lay = np.zeros(B, np.int32)
+        stg = np.zeros(B, np.float32)
+        vec = np.zeros((B, H), np.float32)
+        sta = np.zeros(B, np.int32)
+        bud = np.ones(B, np.int32)
+        kd = np.zeros((B, 2), np.uint32)
+        rm = np.zeros(B, bool)
+        for j, s in enumerate(sel):
+            t = trials[next_trial + j]
+            rm[s] = True
+            sfx[s] = t.suffix_ids
+            msk[s] = t.suffix_mask
+            lay[s] = t.steer_layer
+            stg[s] = t.steer_strength
+            vec[s] = t.steer_vector
+            sta[s] = t.steer_start
+            bud[s] = t.budget
+            kd[s] = trial_keydata[next_trial + j]
+            slot_trial[s] = next_trial + j
+            rem[s] = t.budget - 1
+        cache, state, tok0, flags = scheduler_refill(
+            params, cfg, cache, state, spec,
+            jnp.asarray(sfx), jnp.asarray(msk), jnp.asarray(rm),
+            jnp.asarray(lay), jnp.asarray(stg), jnp.asarray(vec),
+            jnp.asarray(sta), jnp.asarray(bud), jnp.asarray(kd),
+        )
+        # Satellite of the pipelined loop: tok0 rides the same non-blocking
+        # D2H path as the flags — no per-refill host sync.
+        flags.copy_to_host_async()
+        tok0.copy_to_host_async()
+        pending.append(_InFlight("refill", flags, tok0, slot_trial.copy()))
+        gauges.dispatched(len(pending))
+        next_trial += take
+        refills += 1
 
-        if next_trial < N and (len(free) >= refill_min or n_live == 0):
-            take = min(len(free), N - next_trial)
-            sel = free[:take]
-            sfx = np.zeros((B, Ss), np.int32)
-            msk = np.zeros((B, Ss), np.int32)
-            lay = np.zeros(B, np.int32)
-            stg = np.zeros(B, np.float32)
-            vec = np.zeros((B, H), np.float32)
-            sta = np.zeros(B, np.int32)
-            bud = np.ones(B, np.int32)
-            kd = np.zeros((B, 2), np.uint32)
-            rm = np.zeros(B, bool)
-            for j, s in enumerate(sel):
-                t = trials[next_trial + j]
-                rm[s] = True
-                sfx[s] = t.suffix_ids
-                msk[s] = t.suffix_mask
-                lay[s] = t.steer_layer
-                stg[s] = t.steer_strength
-                vec[s] = t.steer_vector
-                sta[s] = t.steer_start
-                bud[s] = t.budget
-                kd[s] = trial_keydata[next_trial + j]
-                slot_trial[s] = next_trial + j
-            cache, state, tok0 = scheduler_refill(
-                params, cfg, cache, state, spec,
-                jnp.asarray(sfx), jnp.asarray(msk), jnp.asarray(rm),
-                jnp.asarray(lay), jnp.asarray(stg), jnp.asarray(vec),
-                jnp.asarray(sta), jnp.asarray(bud), jnp.asarray(kd),
-            )
-            tok0 = np.asarray(tok0)
-            for s in sel:
-                bufs[s] = [tok0[s : s + 1]]
-            next_trial += take
-            refills += 1
-            # Loop back to harvest trials that finished at their first
-            # token (EOS / budget 1 / stop hit) before burning a chunk.
-            continue
-
-        if n_live == 0:
-            break  # queue drained, machine empty
-
+    def _dispatch_chunk() -> None:
+        nonlocal cache, state, g
         page = jnp.int32(g % n_chunks) if n_chunks else jnp.int32(0)
-        cache, state, toks = scheduler_decode_chunk(
+        cache, state, toks, flags = scheduler_decode_chunk(
             params, cfg, cache, state, spec, page, ch=ch
         )
         g += 1
-        toks = np.asarray(toks)
+        flags.copy_to_host_async()
+        toks.copy_to_host_async()
+        pending.append(_InFlight("chunk", flags, toks, slot_trial.copy()))
+        gauges.dispatched(len(pending))
+        assigned = slot_trial >= 0
+        rem[assigned] = np.maximum(rem[assigned] - ch, 0)
+
+    def _process_one() -> None:
+        nonlocal occupancy_sum, waste_steps, chunks_done, last_done
+        ev = pending.popleft()
+        t0 = time.perf_counter()
+        flags = np.asarray(ev.flags)  # lands the async copy (blocks if early)
+        toks = np.asarray(ev.toks)
+        wait_s = time.perf_counter() - t0
+        gauges.waited(wait_s)
+        done = flags[:B] != 0
+        n_em = flags[B:]
+        if ev.kind == "chunk":
+            # Device-truth occupancy: a slot was live for this chunk iff it
+            # was assigned at dispatch and not done at the preceding event.
+            live = int(((ev.owners >= 0) & ~last_done).sum())
+            occupancy_sum += live / B
+            waste_steps += (B - live) * ch
+            chunks_done += 1
+            for s in range(B):
+                ti = int(ev.owners[s])
+                if ti >= 0 and results[ti] is None:
+                    bufs[ti].append(toks[s])
+            ledger.event(
+                "slot_occupancy",
+                chunk=chunks_done,
+                occupied=int(live),
+                slots=int(B),
+                frac=round(live / B, 4),
+                padded_waste_steps_total=int(waste_steps),
+                host_wait_ms=round(1e3 * wait_s, 3),
+                inflight_depth=len(pending),
+            )
+        else:  # refill: tok0 seeds each just-admitted trial's buffer
+            for s in range(B):
+                ti = int(ev.owners[s])
+                if ti >= 0 and results[ti] is None and not bufs[ti]:
+                    bufs[ti].append(toks[s : s + 1])
         for s in range(B):
-            if slot_trial[s] >= 0:
-                bufs[s].append(toks[s])
-        occupancy_sum += n_live / B
-        waste_steps += (B - n_live) * ch
-        ledger.event(
-            "slot_occupancy",
-            chunk=g,
-            occupied=int(n_live),
-            slots=int(B),
-            frac=round(n_live / B, 4),
-            padded_waste_steps_total=int(waste_steps),
-        )
+            ti = int(ev.owners[s])
+            if ti >= 0 and results[ti] is None and done[s]:
+                toks_all = (
+                    np.concatenate(bufs[ti]) if bufs[ti]
+                    else np.zeros(0, np.int32)
+                )
+                results[ti] = toks_all[: int(n_em[s])]
+                bufs[ti] = []
+                if slot_trial[s] == ti:
+                    slot_trial[s] = -1
+                    rem[s] = 0
+                if result_cb is not None:
+                    result_cb(ti, results[ti])
+        last_done = done
+        if not pending:
+            gauges.idle_start()
+
+    while True:
+        # Land results until at most `depth` dispatches remain in flight:
+        # depth 0 reproduces the synchronous loop's decision sequence (and
+        # therefore its stats) exactly; depth 1 keeps one op outstanding.
+        while len(pending) > depth:
+            _process_one()
+        free_cnt = int((slot_trial < 0).sum())
+        n_live_known = B - free_cnt
+        if next_trial < N and (free_cnt >= refill_min or n_live_known == 0):
+            _dispatch_refill()
+            # Loop back: the refill's flags surface trials that finished at
+            # their first token (EOS / budget 1 / stop) before burning a
+            # chunk — in pipelined mode they land one dispatch later.
+            continue
+        if n_live_known == 0:
+            while pending:  # stale all-dead chunks from the wave tail
+                _process_one()
+            if int((slot_trial < 0).sum()) == B and next_trial >= N:
+                break
+            continue
+        if pending and not np.any((slot_trial >= 0) & (rem > 0)):
+            # Budget horizon: every occupied slot is provably exhausted by
+            # in-flight work — a speculative chunk would be all-dead. Land
+            # the oldest result instead and re-decide.
+            _process_one()
+            continue
+        _dispatch_chunk()
 
     assert all(r is not None for r in results)
+    wall_s = time.perf_counter() - t_loop0
     stats = {
         "chunks": g,
         "refills": refills,
-        "mean_slot_occupancy": round(occupancy_sum / g, 4) if g else 1.0,
+        "mean_slot_occupancy": (
+            round(occupancy_sum / chunks_done, 4) if chunks_done else 1.0
+        ),
         "padded_row_waste_steps": int(waste_steps),
+        "pipelined": bool(pipeline),
+        **gauges.as_stats(wall_s, chunks_done),
     }
     return results, stats

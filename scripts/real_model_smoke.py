@@ -43,8 +43,14 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def resolve_checkpoint(model: str) -> Path:
-    """Local directory as-is; otherwise snapshot-download the HF repo."""
+def resolve_checkpoint(model: str):
+    """Local directory as-is; otherwise snapshot-download the HF repo.
+
+    ``tiny`` / ``tiny:<seed>`` passes through to the sweep's random-init
+    smoke subject — lets the script's own plumbing (including the
+    --attn-impl parity mode) run offline without a checkpoint."""
+    if model.startswith("tiny"):
+        return model
     path = Path(model)
     if (path / "config.json").exists():
         return path
@@ -179,6 +185,14 @@ def main(argv=None) -> int:
                          "set, else none)")
     ap.add_argument("--judge-model", default=None,
                     help="on-device judge checkpoint (default: the subject)")
+    ap.add_argument("--attn-impl", choices=["xla", "flash", "flash_cached"],
+                    default=None,
+                    help="Attention implementation for the smoke sweep. "
+                         "flash/flash_cached additionally run a HARDWARE "
+                         "parity check: the same cell greedily under the "
+                         "reference xla attention vs the fused kernel on the "
+                         "real backend (the Pallas kernels are otherwise "
+                         "only oracle-checked in interpret mode on CPU)")
     args = ap.parse_args(argv)
     if args.parity:
         if args.model is None:
@@ -195,23 +209,78 @@ def main(argv=None) -> int:
     print(f"checkpoint: {ckpt}")
 
     from introspective_awareness_tpu.cli.sweep import main as sweep_main
+    from introspective_awareness_tpu.metrics import config_dir
 
-    rc = sweep_main([
-        "--models", str(ckpt),
-        "--concepts", args.concept,
-        "--layer-fraction", f"{args.layer_fraction}",
-        "--strength", f"{args.strength}",
-        "--n-trials", str(args.n_trials),
-        "--max-tokens", str(args.max_tokens),
-        "--output-dir", args.output_dir,
-        "--judge-backend", "none",
-        "--overwrite",
-    ])
+    def run_cell(out_dir: str, attn_impl=None, temperature=None):
+        """One smoke cell; returns (rc, responses) from its results.json."""
+        cell_argv = [
+            "--models", str(ckpt),
+            "--concepts", args.concept,
+            "--layer-fraction", f"{args.layer_fraction}",
+            "--strength", f"{args.strength}",
+            "--n-trials", str(args.n_trials),
+            "--max-tokens", str(args.max_tokens),
+            "--output-dir", out_dir,
+            "--judge-backend", "none",
+            "--overwrite",
+        ]
+        if attn_impl is not None:
+            cell_argv += ["--attn-impl", attn_impl]
+        if temperature is not None:
+            cell_argv += ["--temperature", str(temperature)]
+        rc = sweep_main(cell_argv)
+        if rc != 0:
+            return rc, []
+        cell = config_dir(out_dir, str(ckpt), args.layer_fraction,
+                          args.strength)
+        data = json.loads((cell / "results.json").read_text())
+        return 0, [r["response"] for r in data["results"]]
+
+    if args.attn_impl in ("flash", "flash_cached"):
+        # Hardware parity: the Pallas kernels are oracle-checked against the
+        # xla path only in interpret mode on CPU (tests/); here the SAME cell
+        # runs greedily on the real backend under both implementations and
+        # responses are compared row for row. Near-tied logits may flip
+        # under a different reduction order, so a handful of divergent rows
+        # is tolerated — but a broken kernel diverges everywhere, so a
+        # majority of rows must match exactly and the fused responses must
+        # still pass the coherence heuristics.
+        print(f"attention parity check: xla vs {args.attn_impl} (greedy)")
+        rc, ref = run_cell(f"{args.output_dir}/attn_xla",
+                           attn_impl="xla", temperature=0.0)
+        if rc != 0:
+            print(f"reference (xla) sweep failed (rc={rc})")
+            return rc
+        rc, fused = run_cell(f"{args.output_dir}/attn_{args.attn_impl}",
+                             attn_impl=args.attn_impl, temperature=0.0)
+        if rc != 0:
+            print(f"fused ({args.attn_impl}) sweep failed (rc={rc})")
+            return rc
+        if len(ref) != len(fused):
+            print(f"PARITY FAILED: {len(ref)} xla rows vs "
+                  f"{len(fused)} {args.attn_impl} rows")
+            return 1
+        same = sum(a == b for a, b in zip(ref, fused))
+        frac = same / max(1, len(ref))
+        print(f"identical responses: {same}/{len(ref)} ({frac:.0%})")
+        for i, (a, b) in enumerate(zip(ref, fused)):
+            if a != b:
+                print(f"  row {i} diverged:\n    xla:   {a[:100]!r}"
+                      f"\n    fused: {b[:100]!r}")
+        ok, problems = coherence_report(fused)
+        if frac < 0.5 or not ok:
+            print(f"ATTENTION PARITY CHECK FAILED "
+                  f"(identical={frac:.0%}, coherent={ok}):")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+        print(f"attention parity check passed ({args.attn_impl})")
+        return 0
+
+    rc, responses = run_cell(args.output_dir, attn_impl=args.attn_impl)
     if rc != 0:
         print(f"sweep failed (rc={rc})")
         return rc
-
-    from introspective_awareness_tpu.metrics import config_dir
 
     cell = config_dir(
         args.output_dir, str(ckpt), args.layer_fraction, args.strength

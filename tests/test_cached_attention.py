@@ -95,11 +95,12 @@ def test_flash_cached_generation_token_identity():
     params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
     B, S = 3, 23
     rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(1, 200, size=(B, S)), jnp.int32)
-    m = np.ones((B, S), np.int32)
+    # Host arrays: generate_tokens donates ids/mask, so device arrays would
+    # be deleted by the first impl's call and unusable for the second.
+    ids = np.asarray(rng.integers(1, 200, size=(B, S)), np.int32)
+    mask = np.ones((B, S), np.int32)
     for b, p in enumerate([0, 3, 7]):
-        m[b, :p] = 0
-    mask = jnp.asarray(m)
+        mask[b, :p] = 0
     ids = ids * mask
     vecs = jnp.asarray(rng.normal(size=(B, cfg.hidden_size)), jnp.float32)
     spec = GenSpec(

@@ -82,8 +82,10 @@ def test_early_exit_pads_after_eos():
     cfg = tiny_config(n_layers=2)
     params = init_params(cfg, jax.random.key(0))
     B, S = 2, 8
-    ids = jnp.asarray(np.arange(B * S).reshape(B, S) % cfg.vocab_size, jnp.int32)
-    mask = jnp.ones((B, S), jnp.int32)
+    # Host arrays: generate_tokens donates ids/mask, so device arrays would
+    # be deleted by the first call and unusable for the second.
+    ids = np.asarray(np.arange(B * S).reshape(B, S) % cfg.vocab_size, np.int32)
+    mask = np.ones((B, S), np.int32)
 
     def spec(eos):
         return GenSpec(

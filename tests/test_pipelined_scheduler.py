@@ -1,0 +1,216 @@
+"""Pipelined scheduler loop: bit-identity with the synchronous loop (greedy
+and sampled, across slot counts and chunk sizes), occupancy/waste stats
+preserved under the one-chunk harvest lag, and streamed grading producing the
+same ordered results as the post-hoc judge path."""
+
+import jax
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.models import (
+    ByteTokenizer,
+    init_params,
+    tiny_config,
+)
+from introspective_awareness_tpu.obs import RunLedger
+from introspective_awareness_tpu.runtime import ModelRunner
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def runner(setup):
+    cfg, params = setup
+    return ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4,
+    )
+
+
+COMMON = "The quick brown fox jumps over the lazy dog. " * 4
+
+
+def _queue(n, hidden):
+    """Same shape as test_scheduler._queue: shared preamble, ragged suffixes,
+    a strength-0 row every third trial, steer starts inside the padding."""
+    prompts, starts, strengths, layers = [], [], [], []
+    for i in range(n):
+        p = (
+            COMMON
+            + f"Trial {i + 1}: Do you detect an injected thought"
+            + "?" * (i % 3 + 1)
+        )
+        prompts.append(p)
+        if i % 3 == 2:
+            strengths.append(0.0)
+            starts.append(None)
+        else:
+            strengths.append(6.0 + i)
+            starts.append(len(p) - 10)
+        layers.append(1 + i % 2)
+    rng = np.random.default_rng(7)
+    vecs = [rng.standard_normal(hidden).astype(np.float32) * 4.0
+            for _ in range(n)]
+    return prompts, layers, vecs, strengths, starts
+
+
+def test_pipelined_matches_sync_greedy_mixed_budgets(runner):
+    """The tentpole identity guarantee: with one chunk speculatively in
+    flight, harvest decisions lag one chunk — but greedy text must be
+    bit-identical to the land-every-dispatch loop, across slot counts and a
+    mixed-budget queue that forces refills mid-flight."""
+    N = 8
+    prompts, layers, vecs, strengths, starts = _queue(N, runner.cfg.hidden_size)
+    budgets = [3, 12, 6, 12, 3, 8, 12, 5]
+    kw = dict(
+        max_new_tokens=12, temperature=0.0,
+        steering_start_positions=starts, budgets=budgets, seed=0,
+    )
+    for slots in (2, 3):
+        sync = runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, slots=slots, pipeline=False, **kw
+        )
+        pipe = runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, slots=slots, pipeline=True, **kw
+        )
+        assert pipe == sync, f"pipelined diverged at slots={slots}"
+
+
+def test_pipelined_matches_sync_sampled(runner):
+    """temp > 0: the per-trial PRNG is queue-indexed, so sampled text must be
+    invariant to BOTH the slot count and the pipeline depth — four loop
+    shapes, one answer."""
+    prompts, layers, vecs, strengths, starts = _queue(6, runner.cfg.hidden_size)
+    kw = dict(
+        max_new_tokens=10, temperature=0.9,
+        steering_start_positions=starts, seed=11,
+    )
+    outs = [
+        runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, slots=slots, pipeline=pipe, **kw
+        )
+        for slots in (2, 4)
+        for pipe in (False, True)
+    ]
+    assert all(o == outs[0] for o in outs[1:])
+
+
+def test_pipelined_chunk_size_invariance(runner, monkeypatch):
+    """Chunk size changes how far the speculative dispatch runs past a
+    trial's EOS/budget (dead steps are chunk-granular); output must not
+    notice."""
+    from introspective_awareness_tpu.runtime import generate as gen
+
+    prompts, layers, vecs, strengths, starts = _queue(5, runner.cfg.hidden_size)
+    budgets = [4, 12, 7, 12, 3]
+
+    def run(pipe):
+        return runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, max_new_tokens=12,
+            temperature=0.0, steering_start_positions=starts,
+            budgets=budgets, seed=0, slots=2, pipeline=pipe,
+        )
+
+    monkeypatch.setattr(gen, "RING_CHUNK", 4)
+    fine_sync, fine_pipe = run(False), run(True)
+    monkeypatch.setattr(gen, "RING_CHUNK", 16)
+    coarse_pipe = run(True)
+    assert fine_pipe == fine_sync
+    assert coarse_pipe == fine_sync
+
+
+def test_pipelined_stats_preserved_single_wave(setup):
+    """Occupancy/waste accounting under the one-chunk lag: on a single-wave
+    (N <= slots) budget-forced queue the host-side budget horizon makes the
+    pipelined loop dispatch the exact chunk sequence of the sync loop, so
+    chunks/refills/occupancy/waste must all be EQUAL, not merely close.
+
+    Budget-forced matters: the tiny random-init model never emits EOS within
+    these budgets (the mixed-budget bit-identity tests above depend on the
+    same fact), so the only termination signal is the budget — which the
+    host tracks without waiting for device flags."""
+    cfg, params = setup
+    ledger = RunLedger(path=None)
+    runner = ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4, ledger=ledger,
+    )
+    prompts, layers, vecs, strengths, starts = _queue(3, cfg.hidden_size)
+    budgets = [4, 9, 12]
+
+    def stats(pipe):
+        out = runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, max_new_tokens=12,
+            temperature=0.0, steering_start_positions=starts,
+            budgets=budgets, seed=0, slots=4, pipeline=pipe,
+        )
+        spans = [
+            e for e in ledger.events
+            if e.get("ev") == "span" and e.get("phase") == "generate_scheduled"
+        ]
+        return out, spans[-1]
+
+    sync_out, s = stats(False)
+    pipe_out, p = stats(True)
+    assert pipe_out == sync_out
+    assert s["pipelined"] is False and p["pipelined"] is True
+    for key in ("chunks", "refills", "mean_slot_occupancy",
+                "padded_row_waste_steps"):
+        assert p[key] == s[key], f"{key}: pipelined {p[key]} != sync {s[key]}"
+
+
+class _StubJudgeClient:
+    """Deterministic canned judge: verdict depends only on the prompt text,
+    so streamed micro-batches and one post-hoc batch must grade alike."""
+
+    model_name = "stub-judge"
+    overlap_safe = True
+
+    def grade(self, prompts):
+        return [
+            "Answer: YES" if len(p) % 3 else "Answer: NO" for p in prompts
+        ]
+
+
+def test_streamed_grading_matches_post_hoc(runner):
+    """Protocol level: run_grid_pass with a StreamingGradePool (grading
+    concurrent with decode, arbitrary completion order, micro-batched) must
+    return exactly what the ungraded run plus a post-hoc evaluate_batch
+    returns — same dicts, same queue order."""
+    from introspective_awareness_tpu.judge import (
+        LLMJudge,
+        StreamingGradePool,
+        reconstruct_trial_prompts,
+    )
+    from introspective_awareness_tpu.protocol.trials import run_grid_pass
+
+    tasks = [
+        ("ocean", t, 0.5, 1 + (t % 2), float(2 * s))
+        for t in range(1, 4)
+        for s in range(1, 3)
+    ]
+    rng = np.random.default_rng(5)
+    vec = rng.standard_normal(runner.cfg.hidden_size).astype(np.float32)
+
+    def lookup(_lf, _concept):
+        return vec
+
+    kw = dict(
+        max_new_tokens=10, temperature=0.0, batch_size=2, seed=3,
+        scheduler="continuous",
+    )
+    plain = run_grid_pass(runner, "injection", tasks, lookup, **kw)
+    post_hoc = LLMJudge(client=_StubJudgeClient()).evaluate_batch(
+        plain, reconstruct_trial_prompts(plain)
+    )
+
+    pool = StreamingGradePool(LLMJudge(client=_StubJudgeClient()))
+    streamed = run_grid_pass(
+        runner, "injection", tasks, lookup, grade_pool=pool, **kw
+    )
+    assert streamed == post_hoc
