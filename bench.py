@@ -463,6 +463,109 @@ def _pipeline_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     return r
 
 
+def _staged_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
+    """Staged admission vs synchronous refill on an admission-churny queue.
+
+    The queue is built to stress admission, not decode: budgets cycle five
+    short trials per long one (slots churn constantly), and suffix lengths
+    mix short rows with occasional long ones — the long rows inflate the
+    queue-wide padded suffix width Ss, which is the width EVERY synchronous
+    ``scheduler_refill`` pays ([slots, Ss] against the live cache), while
+    staged admission prefills each group at its own bucketed [R, Sb] shape
+    against the immutable prefix KV and admits via a FLOP-free scatter.
+    Both legs run the identical pipelined host loop; only the admission
+    mechanism differs, and greedy outputs must be bit-identical.
+
+    ``prefill_overlap_frac`` is the fraction of staged rows whose stage
+    dispatch was issued behind in-flight device work (a decode chunk or a
+    prior admission) — the overlap the synchronous refill structurally
+    cannot have (it consumes the donated live decode cache, so it
+    serializes behind everything in flight).
+    """
+    import time as _time
+
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    runner = ModelRunner(
+        runner.params, cfg, tok, model_name="bench-staged",
+        seq_multiple=16, batch_multiple=slots, ledger=ledger,
+    )
+    N = 3 * slots
+    sched_max = max(max_new, 64)
+    prompts, vecs, starts = _build_workload(cfg, tok, N)
+    # Every 6th prompt grows a long suffix tail: the queue-wide Ss pads to
+    # the longest suffix, so the sync refill pays the long width for every
+    # admission while staged groups of short rows stay in small Sb buckets.
+    long_tail = (
+        " Describe the injected thought, its origin, and how it differs "
+        "from your own internally generated thoughts, in detail." * 2
+    )
+    prompts = [
+        p + long_tail if i % 6 == 5 else p for i, p in enumerate(prompts)
+    ]
+    starts = [len(tok.encode(p)) - 60 for p in prompts]
+    layers = [int(cfg.n_layers * 0.6)] * N
+    strengths = [4.0] * N
+    cyc = [max(2, sched_max // 8)] * 5 + [sched_max]
+    budgets = [cyc[i % len(cyc)] for i in range(N)]
+
+    def run(staged):
+        return runner.generate_grid_scheduled(
+            prompts, layers, list(vecs), strengths, max_new_tokens=sched_max,
+            temperature=0.0, steering_start_positions=starts,
+            budgets=budgets, seed=0, slots=slots, refill_frac=0.5,
+            staged=staged,
+        )
+
+    def span_gauges():
+        spans = [
+            e for e in ledger.events
+            if e.get("ev") == "span" and e.get("phase") == "generate_scheduled"
+        ]
+        return spans[-1] if spans else {}
+
+    run(False)
+    run(True)  # warm both admission mechanisms (compile stage/admit buckets)
+
+    t0 = _time.perf_counter()
+    sync_out = run(False)
+    t_sync = _time.perf_counter() - t0
+    g_sync = span_gauges()
+    t0 = _time.perf_counter()
+    staged_out = run(True)
+    t_staged = _time.perf_counter() - t0
+    g_staged = span_gauges()
+    identical = staged_out == sync_out
+
+    r = {
+        "slots": slots,
+        "queue_trials": N,
+        "budget_cycle": cyc,
+        "suffix_len_padded": g_staged.get("suffix_len"),
+        "sync_time_s": round(t_sync, 3),
+        "staged_time_s": round(t_staged, 3),
+        "speedup": round(t_sync / t_staged, 3) if t_staged > 0 else None,
+        "outputs_identical": identical,
+        "prefill_overlap_frac": g_staged.get("prefill_overlap_frac"),
+        "stage_inflight": g_staged.get("stage_inflight"),
+        "admit_wait_ms": g_staged.get("admit_wait_ms"),
+        "suffix_buckets": g_staged.get("suffix_buckets"),
+        "stages": g_staged.get("stages"),
+        "admits": g_staged.get("admits"),
+        "refills_sync": g_sync.get("refills"),
+        "decode_chunks": {
+            "sync": g_sync.get("chunks"), "staged": g_staged.get("chunks"),
+        },
+    }
+    log(
+        f"  [staged_prefill] {N} churny trials x {slots} slots: sync refill "
+        f"{t_sync:.2f}s vs staged {t_staged:.2f}s -> {r['speedup']}x, "
+        f"identical={identical}, overlap={r['prefill_overlap_frac']}, "
+        f"buckets={r['suffix_buckets']}"
+    )
+    return r
+
+
 def _hbm_model(runner, cfg, batch, prompt_len, max_new) -> float:
     """Modeled HBM bytes read per decode step: every parameter once + the
     full KV-cache buffer (the decode attention reads all T slots each step
@@ -580,6 +683,9 @@ def main() -> None:
 
     # ---- pipelined vs synchronous host loop + grading overlap --------------
     pipe = _pipeline_compare(runner, cfg, tok, batches[0], max_new, ledger)
+
+    # ---- staged admission vs synchronous refill (churny queue) -------------
+    stg = _staged_compare(runner, cfg, tok, batches[0], max_new, ledger)
 
     # ---- int8 weight-quantized variant at the best bf16 batch --------------
     if on_tpu:
@@ -768,6 +874,7 @@ def main() -> None:
         "token_stats": stats,
         "scheduler": sched,
         "pipeline": pipe,
+        "staged_prefill": stg,
         "phases": ledger.summary().get("phases", {}),
         "hbm_preflight": preflight_verdict,
         "hbm_devices": hbm_devices,

@@ -69,7 +69,20 @@ def build_parser() -> argparse.ArgumentParser:
                              "tp, near-tied argmaxes can flip — normal "
                              "cross-executable float drift); temperature>0 "
                              "draws differ (per-trial RNG streams instead "
-                             "of per-batch).")
+                             "of per-batch). Tuning: --batch-size sets the "
+                             "slot count; admissions batch up at a 25% "
+                             "free-slot hysteresis (refill_frac).")
+    parser.add_argument("--staged-prefill", action="store_true",
+                        help="With --scheduler continuous: stage admission "
+                             "prefill ahead of demand against the immutable "
+                             "shared-prefix KV (bucketed [R<=slots, "
+                             "Sb<=suffix] shapes) and admit staged rows "
+                             "into freed slots via a FLOP-free scatter, so "
+                             "admission overlaps decode instead of "
+                             "serializing against it. Outputs are "
+                             "bit-identical to unstaged; see the README "
+                             "staged-admission section for lookahead / "
+                             "suffix-bucket tuning.")
     parser.add_argument("-od", "--output-dir", type=str, default=DEFAULT_OUTPUT_DIR)
     parser.add_argument("-dt", "--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float16", "float32"])

@@ -310,7 +310,8 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 runner, trial_type, tasks, vector_lookup,
                 max_new_tokens=args.max_tokens, temperature=args.temperature,
                 batch_size=args.batch_size, seed=args.seed + k * 1_000_003,
-                scheduler=args.scheduler, grade_pool=_make_pool(),
+                scheduler=args.scheduler, staged=args.staged_prefill,
+                grade_pool=_make_pool(),
             )
             fused += out
             # Pass-granular timings: the fused grid has no per-cell unit of
@@ -357,7 +358,7 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 # byte-identical replays along the strength axis.
                 layer_fraction=lf, batch_size=args.batch_size,
                 seed=args.seed + ci * len(strengths) + si,
-                scheduler=args.scheduler,
+                scheduler=args.scheduler, staged=args.staged_prefill,
             )
             results = []
             for trial_type, trial_nums in trial_plan:
@@ -381,6 +382,7 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             _print_cell(lf, strength, metrics)
 
     timings["scheduler"] = args.scheduler
+    timings["staged_prefill"] = bool(args.staged_prefill)
     timings["generation_s"] = round(t_gen, 3)
     if n_generated and t_gen > 0:
         # The BASELINE.json north-star counter, recorded per real run — not

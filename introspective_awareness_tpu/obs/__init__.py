@@ -27,7 +27,7 @@ from introspective_awareness_tpu.obs.ledger import (
     Span,
     load_ledger,
 )
-from introspective_awareness_tpu.obs.pipeline import PipelineGauges
+from introspective_awareness_tpu.obs.pipeline import PipelineGauges, StagedGauges
 from introspective_awareness_tpu.obs.preflight import (
     HbmPreflightError,
     PreflightReport,
@@ -49,6 +49,7 @@ __all__ = [
     "NullLedger",
     "PHASES",
     "PipelineGauges",
+    "StagedGauges",
     "PreflightReport",
     "RunLedger",
     "Span",

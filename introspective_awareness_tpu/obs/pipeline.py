@@ -74,3 +74,76 @@ class PipelineGauges:
             ),
             "max_inflight_depth": int(self.max_inflight_depth),
         }
+
+
+@dataclass
+class StagedGauges:
+    """Gauges for staged admission (``staged=True`` scheduler host loop).
+
+    - ``prefill_overlap_frac`` — fraction of staged suffix rows whose
+      ``scheduler_stage`` dispatch was issued while device work (a decode
+      chunk or a prior admission scatter) was in flight. A host-side proxy
+      for stage/decode overlap: the stage op reads only params + the
+      immutable prefix KV, so under JAX async dispatch it executes
+      concurrently with whatever was in flight (the sync refill is always
+      0 here — it consumes the donated live cache, so it serializes behind
+      every in-flight op and *cannot* overlap).
+    - ``admit_wait_ms`` — host wall time spent between slots becoming
+      admission-eligible and the admit dispatch because the staged pool was
+      empty (staging failed to run ahead of demand). ~0 means the lookahead
+      kept admission off the critical path.
+    - ``stage_inflight`` — high-water mark of staged groups dispatched but
+      not yet fully admitted (the lookahead pool depth).
+    - ``suffix_buckets`` — histogram: padded stage width Sb → staged rows.
+      Many rows in small buckets = the staging pass is doing less work
+      than the queue-wide ``[B, Ss]`` refill would.
+    """
+
+    stages: int = 0
+    admits: int = 0
+    staged_rows: int = 0
+    overlapped_rows: int = 0
+    admit_wait_s: float = 0.0
+    max_stage_inflight: int = 0
+    suffix_buckets: dict = field(default_factory=dict)
+
+    # -- recording ----------------------------------------------------------
+
+    def staged(
+        self, rows: int, bucket: int, inflight: int, overlapped: bool
+    ) -> None:
+        """One ``scheduler_stage`` dispatch of ``rows`` real rows padded to
+        width ``bucket``; ``overlapped`` = decode work was in flight."""
+        self.stages += 1
+        self.staged_rows += rows
+        if overlapped:
+            self.overlapped_rows += rows
+        if inflight > self.max_stage_inflight:
+            self.max_stage_inflight = inflight
+        key = int(bucket)
+        self.suffix_buckets[key] = self.suffix_buckets.get(key, 0) + rows
+
+    def admitted(self) -> None:
+        self.admits += 1
+
+    def admit_waited(self, seconds: float) -> None:
+        """Admission was demanded but the staged pool was empty."""
+        self.admit_wait_s += seconds
+
+    # -- reporting ----------------------------------------------------------
+
+    def as_stats(self) -> dict:
+        return {
+            "stages": int(self.stages),
+            "admits": int(self.admits),
+            "staged_rows": int(self.staged_rows),
+            "stage_inflight": int(self.max_stage_inflight),
+            "admit_wait_ms": round(1e3 * self.admit_wait_s, 3),
+            "prefill_overlap_frac": (
+                round(self.overlapped_rows / self.staged_rows, 4)
+                if self.staged_rows else 0.0
+            ),
+            "suffix_buckets": {
+                str(k): int(v) for k, v in sorted(self.suffix_buckets.items())
+            },
+        }

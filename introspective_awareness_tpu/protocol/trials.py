@@ -34,6 +34,7 @@ def run_trial_pass(
     seed: Optional[int] = None,
     debug: bool = False,
     scheduler: str = "batch",
+    staged: bool = False,
     grade_pool=None,
 ) -> list[dict]:
     """One batched pass of a trial type over (concept, trial) tasks.
@@ -48,6 +49,8 @@ def run_trial_pass(
     ``scheduler="continuous"`` drains the tasks through the persistent
     decode-slot scheduler (``batch_size`` slots) instead of fixed batches —
     identical greedy results, rows freed at EOS instead of at batch end.
+    ``staged=True`` (continuous only) overlaps admission prefill with
+    decode via staged suffix prefill — also output-identical.
     """
     if trial_type not in TRIAL_TYPES:
         raise ValueError(f"unknown trial_type {trial_type!r} (expected {TRIAL_TYPES})")
@@ -62,7 +65,7 @@ def run_trial_pass(
             lambda _lf, c: vectors[c],
             max_new_tokens=max_new_tokens, temperature=temperature,
             batch_size=batch_size, seed=seed, scheduler="continuous",
-            grade_pool=grade_pool,
+            staged=staged, grade_pool=grade_pool,
         )
     if scheduler != "batch":
         raise ValueError(f"unknown scheduler {scheduler!r}")
@@ -127,6 +130,7 @@ def run_grid_pass(
     batch_size: int = 256,
     seed: Optional[int] = None,
     scheduler: str = "batch",
+    staged: bool = False,
     grade_pool=None,
 ) -> list[dict]:
     """One batched pass where every row may belong to a DIFFERENT
@@ -207,6 +211,7 @@ def run_grid_pass(
             steering_start_positions=starts,
             seed=seed,
             slots=batch_size,
+            staged=staged,
             result_cb=result_cb,
         )
         if grade_pool is None:

@@ -408,6 +408,19 @@ def generate_tokens_prefix(
 #                       ring/merged entries stay invalid), folded into the
 #                       merged buffer at an explicit recycled page.
 #
+# Staged admission (the ``staged=True`` host loop) splits the refill into
+# two more executables so admission prefill overlaps decode instead of
+# serializing against it (scheduler_refill consumes the live cache/state):
+#
+#   scheduler_stage   — prefill a batch of INCOMING suffixes only
+#                       ([R <= B, Sb <= Ss], bucketed shapes) against the
+#                       immutable batch-1 prefix KV. Depends only on
+#                       params + prefix KV, so it dispatches concurrently
+#                       with in-flight decode chunks.
+#   scheduler_admit   — FLOP-free scatter of staged rows into freed slots
+#                       of the live cache/state (donation-safe; same [2B]
+#                       flags contract as scheduler_refill).
+#
 # Page recycling: the merged buffer keeps P = n_chunks pages and the host
 # writes chunk g at page g % P with ``mlen`` pinned to the full buffer, so
 # ``mvalid`` alone gates reads. This is sound because chunks are globally
@@ -466,7 +479,10 @@ def _stop_hit(stop: jax.Array, tail: jax.Array) -> jax.Array:
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "slots", "suffix_len", "max_new_tokens", "stop_width"),
+    static_argnames=(
+        "cfg", "slots", "suffix_len", "max_new_tokens", "stop_width",
+        "with_prefix",
+    ),
 )
 def scheduler_init(
     params: dict,
@@ -477,6 +493,7 @@ def scheduler_init(
     suffix_len: int,
     max_new_tokens: int,  # queue-wide max budget; sizes the chunk plan
     stop_width: int = 0,  # Ls of the stop-seq table (0 = no stop matching)
+    with_prefix: bool = False,  # also return the batch-1 prefix KV (staged)
 ) -> tuple:
     """Build the persistent slot cache + empty slot state.
 
@@ -484,7 +501,12 @@ def scheduler_init(
     ``slots`` rows (identical to ``generate_tokens_prefix`` steps 1-2), and
     allocates the decode tiers: a chunk-sized ring plus ``n_chunks`` merged
     pages with ``mlen`` pinned to the full buffer (page recycling — see the
-    module comment). All slots start done/empty."""
+    module comment). All slots start done/empty.
+
+    ``with_prefix=True`` additionally returns the batch-1 prefix KV
+    ``(pk, pv)`` — the immutable operand ``scheduler_stage`` prefills
+    incoming suffixes against. A separate static variant so the non-staged
+    loop's compiled program is unchanged."""
     B = slots
     P0 = prefix_ids.shape[0]
     L = cfg.n_layers
@@ -538,6 +560,8 @@ def scheduler_init(
         keydata=jnp.zeros((B, 2), jnp.uint32),
         tail=jnp.full((B, stop_width), -2, jnp.int32),
     )
+    if with_prefix:
+        return cache, state, r0.cache.k, r0.cache.v
     return cache, state
 
 
@@ -646,6 +670,200 @@ def scheduler_refill(
     )
     flags = jnp.concatenate([state.done.astype(jnp.int32), state.n_emitted])
     return cache, state, tok0, flags
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def scheduler_stage(
+    params: dict,
+    cfg: ModelConfig,
+    prefix_k: jax.Array,  # [L, 1, P0, KVH, KD] — batch-1 prefix KV (init)
+    prefix_v: jax.Array,  # [L, 1, P0, KVH, VD] (VD may be 0 for MLA)
+    spec: SchedSpec,
+    suffix_ids: jax.Array,  # [R, Sb] left-padded; zero-filled filler rows
+    suffix_mask: jax.Array,  # [R, Sb] — filler rows all-zero
+    new_layer: jax.Array,  # [R] int32
+    new_strength: jax.Array,  # [R] f32
+    new_vectors: jax.Array,  # [R, H] f32
+    new_start: jax.Array,  # [R] int32, PADDED Sb-WINDOW coords
+    new_budget: jax.Array,  # [R] int32
+    new_keydata: jax.Array,  # [R, 2] uint32
+) -> tuple:
+    """Prefill a batch of incoming suffixes against the immutable prefix KV.
+
+    The staged half of admission: runs the same suffix pass as
+    ``scheduler_refill`` but over a *fresh* ``[R, Sb]``-shaped cache whose
+    slot tier holds only the broadcast shared prefix — it never touches the
+    live decode cache/state, so the host can dispatch it while decode
+    chunks are in flight. ``R <= B`` and ``Sb <= Ss`` are bucketed shapes
+    (a handful of executables, not one per admission width).
+
+    Ring layout matches the refill exactly: suffix token j of a row lands
+    at ring index j, left-padded — so ``scheduler_admit``'s LEFT-pad of the
+    ``Sb`` window into the ``Ss`` suffix region puts every real token at
+    the identical physical slot the synchronous refill would have used
+    (same masked softmax terms in the same slots → bit-identical decode).
+
+    Returns ``(sk, sv, smask, spos, tok0, done0, true_sfx, keydata, tail0)``
+    — staged suffix KV ``[L, R, Sb, ...]`` in cache dtype plus the entry
+    state ``scheduler_admit`` scatters into freed slots. ``true_sfx`` is
+    the row's REAL suffix length (admit adds the prefix length).
+    """
+    R, Sb = suffix_ids.shape
+    P0 = prefix_k.shape[2]
+    L = cfg.n_layers
+    dtype = params["embed"].dtype
+
+    cache = init_cache(cfg, R, P0, dtype, ring_len=Sb)
+
+    def put_prefix(dst, src):
+        rows = jnp.broadcast_to(src[:, :1], (L, R) + src.shape[2:])
+        return lax.dynamic_update_slice(
+            dst, rows.astype(dst.dtype), (0, 0, 0, 0, 0)
+        )
+
+    cache = cache._replace(
+        k=put_prefix(cache.k, prefix_k),
+        v=put_prefix(cache.v, prefix_v) if cache.v.shape[-1] else cache.v,
+        slot_mask=cache.slot_mask.at[:, :P0].set(True),
+        positions=cache.positions.at[:, :P0].set(
+            jnp.arange(P0, dtype=jnp.int32)[None]
+        ),
+        length=jnp.int32(P0),
+    )
+    # Same rematerialization hazard as scheduler_init: one broadcast temp.
+    cache = lax.optimization_barrier(cache)
+
+    amask = suffix_mask
+    prompt_pos_mask = (
+        (jnp.arange(Sb)[None, :] >= new_start[:, None]) & (amask > 0)
+    ).astype(jnp.float32)
+    steer_prompt = SteerSpec(
+        new_layer, new_strength, new_vectors, prompt_pos_mask
+    )
+    suffix_pos = P0 + make_positions(amask)
+    r = forward(
+        params, cfg, suffix_ids, amask, suffix_pos,
+        cache=cache, steer=steer_prompt, use_cache=True, logits_mode="last",
+    )
+    rc = r.cache
+    sk = jnp.swapaxes(rc.rk, 1, 2)  # [L, R, Sb, KVH, KD], cache dtype
+    sv = jnp.swapaxes(rc.rv, 1, 2)
+    # Same validity condition merge_suffix_slots applies to the ring.
+    smask = (
+        jnp.arange(Sb, dtype=jnp.int32)[None, :] < rc.rlen
+    ) & rc.rvalid
+    spos = rc.rpos
+
+    tok0, keydata = _slot_sample(r.logits, new_keydata, spec.temperature)
+    done0 = jnp.isin(tok0, spec.eos_ids) | (new_budget <= 1)
+    stop = spec.stop_seqs
+    if stop is not None and stop.shape[0] > 0:
+        tail0 = jnp.full((R, stop.shape[1]), -2, jnp.int32).at[:, -1].set(tok0)
+        done0 = done0 | _stop_hit(stop, tail0)
+    else:
+        tail0 = jnp.zeros((R, 0), jnp.int32)
+    true_sfx = amask.sum(axis=1).astype(jnp.int32)
+    return sk, sv, smask, spos, tok0, done0, true_sfx, keydata, tail0
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "suffix_len"),
+    donate_argnames=("cache", "state"),
+)
+def scheduler_admit(
+    cfg: ModelConfig,
+    cache,
+    state: SlotState,
+    spec: SchedSpec,
+    slot_map: jax.Array,  # [R] int32 — destination slot per staged row, -1 = skip
+    sk: jax.Array,  # [L, R, Sb, KVH, KD] staged suffix keys (cache dtype)
+    sv: jax.Array,  # [L, R, Sb, KVH, VD]
+    smask: jax.Array,  # [R, Sb] bool — staged suffix-slot validity
+    spos: jax.Array,  # [R, Sb] int32 — staged suffix positions
+    tok0: jax.Array,  # [R] int32 — each staged trial's first sampled token
+    done0: jax.Array,  # [R] bool
+    true_sfx: jax.Array,  # [R] int32 — real suffix length
+    new_budget: jax.Array,  # [R] int32
+    new_layer: jax.Array,  # [R] int32
+    new_strength: jax.Array,  # [R] f32
+    new_vectors: jax.Array,  # [R, H] f32
+    new_keydata: jax.Array,  # [R, 2] uint32 — ADVANCED keydata from stage
+    new_tail: jax.Array,  # [R, Ls] int32 (Ls may be 0)
+    *,
+    suffix_len: int,  # Ss — the live cache's suffix-region width
+) -> tuple:
+    """Scatter staged rows into freed slots of the live cache/state.
+
+    The cheap half of admission: no forward pass, just gathers of the
+    staged ``[R, Sb]`` rows LEFT-padded into the ``Ss``-wide suffix region
+    (real tokens land at the exact slots ``merge_suffix_slots`` uses) plus
+    masked state writes — FLOP-free, so it costs a memory pass where
+    ``scheduler_refill`` costs a full suffix prefill against the live
+    cache. Must be called at a chunk boundary (ring folded, ``rlen == 0``),
+    which the host loop guarantees, exactly like the refill.
+
+    Returns ``(cache, state, tok0, flags)`` with the same computed-output
+    ``[done, n_emitted]`` flags contract as ``scheduler_refill`` — the host
+    processes admit events and refill events identically."""
+    B = state.prev.shape[0]
+    Ss = suffix_len
+    T = cache.k.shape[2]
+    P0 = T - Ss
+    Sb = sk.shape[2]
+    pad = Ss - Sb
+
+    # Invert the row→slot map: m[b] = "slot b receives a staged row",
+    # row[b] = which one. slot_map values are unique by construction.
+    hit = slot_map[None, :] == jnp.arange(B, dtype=jnp.int32)[:, None]
+    m = jnp.any(hit, axis=1)  # [B]
+    row = jnp.argmax(hit, axis=1).astype(jnp.int32)  # [B]
+
+    cache = reset_slots(cache, m, P0)
+
+    k_rows = jnp.pad(sk[:, row], ((0, 0), (0, 0), (pad, 0), (0, 0), (0, 0)))
+    sel = m[None, :, None, None, None]
+    new_k = cache.k.at[:, :, P0:].set(
+        jnp.where(sel, k_rows.astype(cache.k.dtype), cache.k[:, :, P0:])
+    )
+    if cache.v.shape[-1]:
+        v_rows = jnp.pad(
+            sv[:, row], ((0, 0), (0, 0), (pad, 0), (0, 0), (0, 0))
+        )
+        new_v = cache.v.at[:, :, P0:].set(
+            jnp.where(sel, v_rows.astype(cache.v.dtype), cache.v[:, :, P0:])
+        )
+    else:
+        new_v = cache.v
+    sel2 = m[:, None]
+    sm_rows = jnp.pad(smask[row], ((0, 0), (pad, 0)))
+    new_slot_mask = cache.slot_mask.at[:, P0:].set(
+        jnp.where(sel2, sm_rows, cache.slot_mask[:, P0:])
+    )
+    pos_rows = jnp.pad(spos[row], ((0, 0), (pad, 0)))
+    new_positions = cache.positions.at[:, P0:].set(
+        jnp.where(sel2, pos_rows, cache.positions[:, P0:])
+    )
+    cache = cache._replace(
+        k=new_k, v=new_v,
+        slot_mask=new_slot_mask, positions=new_positions,
+    )
+
+    tok0_b = jnp.where(m, tok0[row], spec.pad_id)
+    state = SlotState(
+        prev=jnp.where(m, tok0[row], state.prev),
+        done=jnp.where(m, done0[row], state.done),
+        n_emitted=jnp.where(m, 1, state.n_emitted),
+        true_len=jnp.where(m, P0 + true_sfx[row], state.true_len),
+        budget=jnp.where(m, new_budget[row], state.budget),
+        steer_layer=jnp.where(m, new_layer[row], state.steer_layer),
+        steer_strength=jnp.where(m, new_strength[row], state.steer_strength),
+        steer_vectors=jnp.where(sel2, new_vectors[row], state.steer_vectors),
+        keydata=jnp.where(sel2, new_keydata[row], state.keydata),
+        tail=jnp.where(sel2, new_tail[row], state.tail),
+    )
+    flags = jnp.concatenate([state.done.astype(jnp.int32), state.n_emitted])
+    return cache, state, tok0_b, flags
 
 
 @partial(

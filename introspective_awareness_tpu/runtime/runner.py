@@ -599,6 +599,9 @@ class ModelRunner:
         slots: Optional[int] = None,
         refill_frac: float = 0.25,
         pipeline: bool = True,
+        staged: bool = False,
+        lookahead: int = 2,
+        suffix_bucket: int = 16,
         result_cb: Optional[Callable[[int, str], None]] = None,
         **kw,
     ) -> list[str]:
@@ -610,18 +613,23 @@ class ModelRunner:
         row's generation (default: ``max_new_tokens`` for all).
 
         ``pipeline`` keeps one decode chunk in flight (software-pipelined
-        host loop; output-identical — see runtime.scheduler). When
-        ``result_cb`` is given it receives ``(queue_index, decoded_text)``
-        the moment each trial finishes — while decode continues — so the
-        caller can stream finished trials into judge grading; the final
-        return value is still the full in-order list.
+        host loop; output-identical — see runtime.scheduler). ``staged``
+        switches admission to staged suffix prefill (overlapped with
+        decode; also output-identical), with ``lookahead`` staging waves
+        kept in the pool and stage widths quantized to ``suffix_bucket``
+        tokens. When ``result_cb`` is given it receives ``(queue_index,
+        decoded_text)`` the moment each trial finishes — while decode
+        continues — so the caller can stream finished trials into judge
+        grading; the final return value is still the full in-order list.
 
         Eligibility mirrors the shared-prefix path — every prompt must
         share a prefix no steered row steers inside (the sweep's preamble),
         no sequence-parallel mesh, and the merged decode tier must be
         active. Ineligible queues fall back to the fixed-batch path in
-        ``slots``-sized chunks (uniform budgets only: the fallback cannot
-        truncate per-trial without changing sampled text).
+        ``slots``-sized chunks; a mixed-budget queue is grouped by budget
+        first (one batch call per budget group — a single batch call has
+        one ``max_new_tokens``, and truncating per-trial after the fact
+        would change sampled text), preserving input order in the result.
 
         Greedy outputs are bit-identical to the batch path on an unsharded
         runner or a dp-only mesh (test_scheduler.py). Under tensor
@@ -669,35 +677,37 @@ class ModelRunner:
             )
         if L0 == 0:
             # Fixed-batch fallback in slot-sized chunks. One batch call has
-            # a single max_new_tokens, so only a uniform budget is accepted
-            # here; a mixed-budget queue needs the slot path.
-            if len(set(budget_list)) > 1:
-                raise ValueError(
-                    "continuous scheduler ineligible (no shared prefix / "
-                    "seq-parallel mesh / no merged tier) and budgets are "
-                    "non-uniform; use uniform budgets or the batch path"
-                )
-            out: list[str] = []
-            for i in range(0, N, slots):
-                batch = self.generate_batch_with_grid_steering(
-                    prompts[i : i + slots],
-                    list(layer_arr[i : i + slots]),
-                    steering_vectors[i : i + slots],
-                    list(strength_arr[i : i + slots]),
-                    max_new_tokens=budget_list[0],
-                    temperature=temperature,
-                    steering_start_positions=(
-                        None if steering_start_positions is None
-                        else steering_start_positions[i : i + slots]
-                    ),
-                    seed=seed,
-                    stop_strings=stop_strings,
-                )
-                if result_cb is not None:
-                    # Stream at batch granularity (the finest this path has).
-                    for j, text in enumerate(batch):
-                        result_cb(i + j, text)
-                out.extend(batch)
+            # a single max_new_tokens, so a mixed-budget queue is grouped by
+            # budget — one run of slot-sized batch calls per distinct budget
+            # — and results are scattered back to input order. Greedy text
+            # is exact; at temp > 0 batch composition determines each row's
+            # sample stream (one joint key per call), the same caveat the
+            # slot-sized chunking itself already carries on this path.
+            out: list[Optional[str]] = [None] * N
+            for b in sorted(set(budget_list)):
+                idx = [i for i in range(N) if budget_list[i] == b]
+                for c in range(0, len(idx), slots):
+                    chunk = idx[c : c + slots]
+                    batch = self.generate_batch_with_grid_steering(
+                        [prompts[i] for i in chunk],
+                        [int(layer_arr[i]) for i in chunk],
+                        [steering_vectors[i] for i in chunk],
+                        [float(strength_arr[i]) for i in chunk],
+                        max_new_tokens=b,
+                        temperature=temperature,
+                        steering_start_positions=(
+                            None if steering_start_positions is None
+                            else [steering_start_positions[i] for i in chunk]
+                        ),
+                        seed=seed,
+                        stop_strings=stop_strings,
+                    )
+                    for j, i in enumerate(chunk):
+                        out[i] = batch[j]
+                        if result_cb is not None:
+                            # Stream at batch granularity (the finest this
+                            # path has).
+                            result_cb(i, batch[j])
             return out
 
         suffix_rows = [r[L0:] for r in rows]
@@ -753,7 +763,8 @@ class ModelRunner:
                 stop_seqs=None if stop is None else np.asarray(stop),
                 seed=int(seed), refill_frac=refill_frac,
                 ledger=self.ledger,
-                pipeline=pipeline, result_cb=tok_cb,
+                pipeline=pipeline, staged=staged, lookahead=lookahead,
+                suffix_bucket=suffix_bucket, result_cb=tok_cb,
             )
             span.add_evals(N)
             span.add_tokens(int(sum(len(r) for r in results)))

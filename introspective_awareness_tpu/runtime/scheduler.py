@@ -38,6 +38,25 @@ Finished trials surface through ``result_cb`` the moment their flags land
 — while later chunks still decode — which is what lets the caller
 detokenize and fire judge requests concurrently with generation
 (``judge.streaming.StreamingGradePool``).
+
+Staged admission (``staged=True``): the synchronous refill is the one op
+the pipeline cannot hide — ``scheduler_refill`` consumes and re-donates
+the live cache/state, so its full ``[B, Ss]`` masked suffix prefill
+serializes against the decode stream. Staged mode splits admission in two
+(runtime.generate): ``scheduler_stage`` prefills a group of *incoming
+suffixes only* (``[R <= B, Sb <= Ss]`` bucketed shapes) against the
+immutable batch-1 prefix KV — it reads nothing the decode stream writes,
+so the host dispatches it ahead of demand, concurrently with in-flight
+chunks — and ``scheduler_admit`` scatters staged rows into freed slots,
+FLOP-free. The loop keeps a lookahead pool of staged groups (staging runs
+``lookahead`` admission waves ahead, floored at one full batch so the pool
+never starves an admission) and admits from the pool in queue order at the
+exact points the synchronous loop would refill. Identity is preserved for
+the same reasons pipelining preserves it (queue-indexed PRNG, masked
+attention contributes exact zeros, staged rows land at the identical
+physical suffix slots ``merge_suffix_slots`` uses); the admission *timing*
+and slot assignment sequence match the sync loop one-for-one, so
+chunk/occupancy/waste stats are equal too.
 """
 
 from __future__ import annotations
@@ -51,13 +70,15 @@ import jax
 import numpy as np
 
 from introspective_awareness_tpu.models.config import ModelConfig
-from introspective_awareness_tpu.obs import NullLedger, PipelineGauges
+from introspective_awareness_tpu.obs import NullLedger, PipelineGauges, StagedGauges
 from introspective_awareness_tpu.runtime.generate import (
     SchedSpec,
     _chunk_plan,
+    scheduler_admit,
     scheduler_decode_chunk,
     scheduler_init,
     scheduler_refill,
+    scheduler_stage,
 )
 
 import jax.numpy as jnp
@@ -92,10 +113,39 @@ class _InFlight:
     the only host state a later processing step needs to interpret the
     per-slot rows."""
 
-    kind: str  # "chunk" | "refill"
+    kind: str  # "chunk" | "refill" (admits reuse the refill event shape)
     flags: jax.Array  # [2B] int32 — packed [done, n_emitted]
     toks: jax.Array  # chunk: [B, ch] token slab; refill: [B] tok0
     owners: np.ndarray  # [B] queue index per slot at dispatch (-1 = free)
+
+
+@dataclass
+class _StagedGroup:
+    """One ``scheduler_stage`` dispatch awaiting admission.
+
+    Device arrays stay on device until ``scheduler_admit`` gathers them
+    into freed slots; ``cursor`` tracks how many of the group's ``n`` real
+    rows have been admitted (a group may be consumed across several admit
+    calls when fewer slots are free than rows staged). ``qidx`` are queue
+    indices in FIFO order — admission order is queue order, exactly like
+    the synchronous refill."""
+
+    qidx: list  # [n] queue indices (ascending)
+    n: int  # real rows staged (R >= n; filler rows are never admitted)
+    cursor: int
+    sk: jax.Array  # [L, R, Sb, KVH, KD]
+    sv: jax.Array
+    smask: jax.Array  # [R, Sb] bool
+    spos: jax.Array  # [R, Sb] int32
+    tok0: jax.Array  # [R]
+    done0: jax.Array  # [R]
+    true_sfx: jax.Array  # [R]
+    keydata: jax.Array  # [R, 2] — ADVANCED past the tok0 sample
+    tail: jax.Array  # [R, Ls]
+    budget: jax.Array  # [R]
+    layer: jax.Array  # [R]
+    strength: jax.Array  # [R]
+    vectors: jax.Array  # [R, H]
 
 
 def run_scheduled(
@@ -114,6 +164,9 @@ def run_scheduled(
     refill_frac: float = 0.25,
     ledger=None,
     pipeline: bool = True,
+    staged: bool = False,
+    lookahead: int = 2,
+    suffix_bucket: int = 16,
     result_cb: Optional[Callable[[int, np.ndarray], None]] = None,
 ) -> tuple[list[np.ndarray], dict]:
     """Drain ``trials`` through ``slots`` decode rows; returns per-trial
@@ -130,6 +183,15 @@ def run_scheduled(
     identity tests. ``result_cb(queue_index, tokens)`` fires the moment a
     trial is finalized (possibly while decode continues); callbacks run on
     the scheduler thread, so keep them cheap or hand off to a worker pool.
+
+    ``staged=True`` replaces the synchronous refill with staged admission
+    (see the module docstring): suffix prefill runs ahead of demand against
+    the immutable prefix KV in ``suffix_bucket``-quantized widths, and
+    freed slots receive staged rows via a FLOP-free scatter. ``lookahead``
+    scales how many admission waves of rows staging keeps in the pool
+    (floored at one full batch). Greedy outputs are bit-identical to
+    ``staged=False``; ``suffix_bucket <= 0`` disables width bucketing
+    (every stage pads to the queue-wide ``Ss``).
     """
     ledger = ledger if ledger is not None else NullLedger()
     B = slots
@@ -137,7 +199,9 @@ def run_scheduled(
     if N == 0:
         return [], {"chunks": 0, "refills": 0, "mean_slot_occupancy": 0.0,
                     "padded_row_waste_steps": 0, "pipelined": bool(pipeline),
-                    **PipelineGauges().as_stats(0.0, 0)}
+                    "staged": bool(staged),
+                    **PipelineGauges().as_stats(0.0, 0),
+                    **StagedGauges().as_stats()}
     Ss = int(trials[0].suffix_ids.shape[0])
     H = int(trials[0].steer_vector.shape[0])
     for t in trials:
@@ -154,11 +218,18 @@ def run_scheduled(
         stop = jnp.asarray(np.asarray(stop_seqs, np.int32))
     stop_width = int(stop.shape[1]) if stop is not None else 0
 
-    cache, state = scheduler_init(
-        params, cfg, jnp.asarray(np.asarray(prefix_ids, np.int32)),
-        slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
-        stop_width=stop_width,
-    )
+    if staged:
+        cache, state, pk, pv = scheduler_init(
+            params, cfg, jnp.asarray(np.asarray(prefix_ids, np.int32)),
+            slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
+            stop_width=stop_width, with_prefix=True,
+        )
+    else:
+        cache, state = scheduler_init(
+            params, cfg, jnp.asarray(np.asarray(prefix_ids, np.int32)),
+            slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
+            stop_width=stop_width,
+        )
     spec = SchedSpec(
         temperature=jnp.float32(temperature),
         eos_ids=jnp.asarray(np.asarray(eos_ids, np.int32)),
@@ -198,6 +269,37 @@ def run_scheduled(
     waste_steps = 0
     refill_min = max(1, int(refill_frac * B))
     gauges = PipelineGauges()
+    sgauges = StagedGauges()
+    # Staged-admission pool state. Staging runs in group-sized bites (one
+    # refill hysteresis quantum — small groups keep the Sb buckets tight)
+    # and stays `lookahead` admission waves ahead of demand, floored at one
+    # full batch: an admission can demand at most B rows, so a >= B pool
+    # can always mirror the sync loop's "fill every free slot" take.
+    stage_pool: deque[_StagedGroup] = deque()
+    next_stage = 0  # queue index of the next trial to stage
+    stage_group = refill_min
+    lookahead_rows = max(B, int(lookahead) * stage_group)
+    bucket_q = int(suffix_bucket)
+
+    def _pool_rows() -> int:
+        return sum(grp.n - grp.cursor for grp in stage_pool)
+
+    # Reusable host staging buffers for refill packing: allocated once, only
+    # the admitted rows are rewritten per call. Unselected rows keep stale
+    # values from earlier admissions — harmless because scheduler_refill
+    # masks every consumer through refill_mask (attn amask 0 / where(m, ...)
+    # writes), and exactly so: finite stale garbage never reaches a live
+    # lane. jnp.array (copy=True) at dispatch keeps each device operand
+    # independent of the next admission's host-side writes.
+    sfx_buf = np.zeros((B, Ss), np.int32)
+    msk_buf = np.zeros((B, Ss), np.int32)
+    lay_buf = np.zeros(B, np.int32)
+    stg_buf = np.zeros(B, np.float32)
+    vec_buf = np.zeros((B, H), np.float32)
+    sta_buf = np.zeros(B, np.int32)
+    bud_buf = np.ones(B, np.int32)
+    kd_buf = np.zeros((B, 2), np.uint32)
+    rm_buf = np.zeros(B, bool)
     t_loop0 = time.perf_counter()
     gauges.idle_start()  # nothing dispatched yet beyond init
 
@@ -206,33 +308,25 @@ def run_scheduled(
         free = np.flatnonzero(slot_trial < 0)
         take = min(len(free), N - next_trial)
         sel = free[:take]
-        sfx = np.zeros((B, Ss), np.int32)
-        msk = np.zeros((B, Ss), np.int32)
-        lay = np.zeros(B, np.int32)
-        stg = np.zeros(B, np.float32)
-        vec = np.zeros((B, H), np.float32)
-        sta = np.zeros(B, np.int32)
-        bud = np.ones(B, np.int32)
-        kd = np.zeros((B, 2), np.uint32)
-        rm = np.zeros(B, bool)
+        rm_buf[:] = False
         for j, s in enumerate(sel):
             t = trials[next_trial + j]
-            rm[s] = True
-            sfx[s] = t.suffix_ids
-            msk[s] = t.suffix_mask
-            lay[s] = t.steer_layer
-            stg[s] = t.steer_strength
-            vec[s] = t.steer_vector
-            sta[s] = t.steer_start
-            bud[s] = t.budget
-            kd[s] = trial_keydata[next_trial + j]
+            rm_buf[s] = True
+            sfx_buf[s] = t.suffix_ids
+            msk_buf[s] = t.suffix_mask
+            lay_buf[s] = t.steer_layer
+            stg_buf[s] = t.steer_strength
+            vec_buf[s] = t.steer_vector
+            sta_buf[s] = t.steer_start
+            bud_buf[s] = t.budget
+            kd_buf[s] = trial_keydata[next_trial + j]
             slot_trial[s] = next_trial + j
             rem[s] = t.budget - 1
         cache, state, tok0, flags = scheduler_refill(
             params, cfg, cache, state, spec,
-            jnp.asarray(sfx), jnp.asarray(msk), jnp.asarray(rm),
-            jnp.asarray(lay), jnp.asarray(stg), jnp.asarray(vec),
-            jnp.asarray(sta), jnp.asarray(bud), jnp.asarray(kd),
+            jnp.array(sfx_buf), jnp.array(msk_buf), jnp.array(rm_buf),
+            jnp.array(lay_buf), jnp.array(stg_buf), jnp.array(vec_buf),
+            jnp.array(sta_buf), jnp.array(bud_buf), jnp.array(kd_buf),
         )
         # Satellite of the pipelined loop: tok0 rides the same non-blocking
         # D2H path as the flags — no per-refill host sync.
@@ -242,6 +336,108 @@ def run_scheduled(
         gauges.dispatched(len(pending))
         next_trial += take
         refills += 1
+
+    def _dispatch_stage() -> None:
+        """Prefill the next `stage_group` queued suffixes into the pool.
+
+        Shape bucketing keeps the executable count bounded: R rounds the
+        group size up to a power of two (capped at B), Sb rounds the
+        group's max real suffix length up to the `suffix_bucket` quantum
+        (capped at Ss). Suffix rows are re-padded from the queue-wide Ss
+        window into the Sb window by trimming LEFT padding, so real tokens
+        keep their within-window offsets from the right edge — the layout
+        scheduler_admit's left-pad restores exactly."""
+        nonlocal next_stage
+        n = min(stage_group, N - next_stage)
+        rows = trials[next_stage : next_stage + n]
+        n_real = [int(t.suffix_mask.sum()) for t in rows]
+        if bucket_q <= 0:
+            Sb = Ss
+        else:
+            Sb = min(Ss, max(1, -(-max(max(n_real), 1) // bucket_q) * bucket_q))
+        R = min(B, 1 << max(0, (n - 1).bit_length()))
+        sfx = np.zeros((R, Sb), np.int32)
+        msk = np.zeros((R, Sb), np.int32)
+        lay = np.zeros(R, np.int32)
+        stg = np.zeros(R, np.float32)
+        vec = np.zeros((R, H), np.float32)
+        sta = np.zeros(R, np.int32)
+        bud = np.ones(R, np.int32)
+        kd = np.zeros((R, 2), np.uint32)
+        for j, t in enumerate(rows):
+            nr = n_real[j]
+            if nr:
+                sfx[j, Sb - nr:] = t.suffix_ids[Ss - nr:]
+                msk[j, Sb - nr:] = t.suffix_mask[Ss - nr:]
+            lay[j] = t.steer_layer
+            stg[j] = t.steer_strength
+            vec[j] = t.steer_vector
+            # steer_start is in Ss-window coords; the Sb window drops
+            # Ss - Sb columns of left padding.
+            sta[j] = max(0, t.steer_start - (Ss - Sb))
+            bud[j] = t.budget
+            kd[j] = trial_keydata[next_stage + j]
+        budj, layj = jnp.asarray(bud), jnp.asarray(lay)
+        stgj, vecj = jnp.asarray(stg), jnp.asarray(vec)
+        sk, sv, smask, spos, tok0, done0, true_sfx, keydata, tail0 = (
+            scheduler_stage(
+                params, cfg, pk, pv, spec, jnp.asarray(sfx),
+                jnp.asarray(msk), layj, stgj, vecj, jnp.asarray(sta),
+                budj, jnp.asarray(kd),
+            )
+        )
+        # Overlap = dispatched behind ANY in-flight device op (decode chunk
+        # or a prior admission scatter). The stage op reads only params +
+        # the immutable prefix KV, so it is data-independent of everything
+        # in flight and executes concurrently; the sync refill consumes the
+        # donated live cache, so it is structurally always 0 here.
+        overlapped = len(pending) > 0
+        sgauges.staged(n, Sb, len(stage_pool) + 1, overlapped)
+        stage_pool.append(_StagedGroup(
+            qidx=list(range(next_stage, next_stage + n)), n=n, cursor=0,
+            sk=sk, sv=sv, smask=smask, spos=spos, tok0=tok0, done0=done0,
+            true_sfx=true_sfx, keydata=keydata, tail=tail0,
+            budget=budj, layer=layj, strength=stgj, vectors=vecj,
+        ))
+        next_stage += n
+
+    def _dispatch_admit() -> None:
+        """Scatter staged rows into every free slot, FIFO from the pool.
+
+        Consumes groups in queue order, possibly several per admission
+        event (one scheduler_admit dispatch each — the [2B] flags contract
+        makes every one an independent "refill"-kind event for
+        _process_one). Row→slot assignment walks ascending free slots,
+        exactly the sync refill's `free[:take]` mapping."""
+        nonlocal cache, state, next_trial
+        free = np.flatnonzero(slot_trial < 0)
+        fi = 0
+        while fi < len(free) and stage_pool:
+            grp = stage_pool[0]
+            take = min(len(free) - fi, grp.n - grp.cursor)
+            slot_map = np.full(int(grp.sk.shape[1]), -1, np.int32)
+            for j in range(take):
+                s = int(free[fi + j])
+                qi = grp.qidx[grp.cursor + j]
+                slot_map[grp.cursor + j] = s
+                slot_trial[s] = qi
+                rem[s] = trials[qi].budget - 1
+            cache, state, tok0, flags = scheduler_admit(
+                cfg, cache, state, spec, jnp.asarray(slot_map),
+                grp.sk, grp.sv, grp.smask, grp.spos, grp.tok0, grp.done0,
+                grp.true_sfx, grp.budget, grp.layer, grp.strength,
+                grp.vectors, grp.keydata, grp.tail, suffix_len=Ss,
+            )
+            flags.copy_to_host_async()
+            tok0.copy_to_host_async()
+            pending.append(_InFlight("refill", flags, tok0, slot_trial.copy()))
+            gauges.dispatched(len(pending))
+            sgauges.admitted()
+            grp.cursor += take
+            fi += take
+            next_trial += take
+            if grp.cursor >= grp.n:
+                stage_pool.popleft()
 
     def _dispatch_chunk() -> None:
         nonlocal cache, state, g
@@ -319,7 +515,26 @@ def run_scheduled(
             _process_one()
         free_cnt = int((slot_trial < 0).sum())
         n_live_known = B - free_cnt
-        if next_trial < N and (free_cnt >= refill_min or n_live_known == 0):
+        if staged:
+            demand = free_cnt >= refill_min or n_live_known == 0
+            if next_stage < N and _pool_rows() < lookahead_rows:
+                # Top up the lookahead pool. If admission is demanded RIGHT
+                # NOW and the pool is dry, staging sits on the admission
+                # critical path — that stall is the admit_wait gauge.
+                t_dry = (
+                    time.perf_counter()
+                    if demand and _pool_rows() == 0 else None
+                )
+                while next_stage < N and _pool_rows() < lookahead_rows:
+                    _dispatch_stage()
+                if t_dry is not None:
+                    sgauges.admit_waited(time.perf_counter() - t_dry)
+            if demand and _pool_rows() > 0:
+                _dispatch_admit()
+                # Same reason as the sync refill's `continue`: surface
+                # first-token finishes before burning a chunk.
+                continue
+        elif next_trial < N and (free_cnt >= refill_min or n_live_known == 0):
             _dispatch_refill()
             # Loop back: the refill's flags surface trials that finished at
             # their first token (EOS / budget 1 / stop) before burning a
@@ -349,6 +564,8 @@ def run_scheduled(
         ),
         "padded_row_waste_steps": int(waste_steps),
         "pipelined": bool(pipeline),
+        "staged": bool(staged),
         **gauges.as_stats(wall_s, chunks_done),
+        **sgauges.as_stats(),
     }
     return results, stats

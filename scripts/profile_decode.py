@@ -23,6 +23,80 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
+def _stage_breakdown(runner, cfg, tok, args, ledger) -> None:
+    """A/B the slot scheduler's admission mechanisms and print the gauges.
+
+    Runs the same churny queue (mixed short/long suffixes, 5 short budgets
+    per long one) through ``generate_grid_scheduled`` twice — synchronous
+    refill vs staged admission — and attributes each leg's wall clock from
+    the pipeline/staged gauges: host wait, provable device idle, admission
+    stall (``admit_wait_ms``), and the fraction of staged rows whose prefill
+    was dispatched behind an in-flight decode chunk.
+    """
+    from bench import _build_workload
+
+    slots = args.batch
+    N = 3 * slots
+    max_new = max(args.max_new, 64)
+    prompts, vecs, starts = _build_workload(cfg, tok, N)
+    long_tail = (
+        " Describe the injected thought, its origin, and how it differs "
+        "from your own internally generated thoughts, in detail." * 2
+    )
+    prompts = [
+        p + long_tail if i % 6 == 5 else p for i, p in enumerate(prompts)
+    ]
+    starts = [len(tok.encode(p)) - 60 for p in prompts]
+    cyc = [max(2, max_new // 8)] * 5 + [max_new]
+    budgets = [cyc[i % len(cyc)] for i in range(N)]
+    layers = [int(cfg.n_layers * 0.6)] * N
+
+    def run(staged):
+        return runner.generate_grid_scheduled(
+            prompts, layers, list(vecs), [4.0] * N, max_new_tokens=max_new,
+            temperature=0.0, steering_start_positions=starts,
+            budgets=budgets, seed=0, slots=slots, refill_frac=0.5,
+            staged=staged,
+        )
+
+    def last_span():
+        spans = [
+            e for e in ledger.events
+            if e.get("ev") == "span" and e.get("phase") == "generate_scheduled"
+        ]
+        return spans[-1] if spans else {}
+
+    legs = {}
+    for staged in (False, True):
+        run(staged)  # warm/compile this leg
+        t0 = time.perf_counter()
+        out = run(staged)
+        legs[staged] = (time.perf_counter() - t0, last_span(), out)
+
+    t_sync, g_sync, o_sync = legs[False]
+    t_staged, g_staged, o_staged = legs[True]
+    print(f"\n== stage breakdown: {N} trials x {slots} slots, "
+          f"budgets {cyc} ==")
+    for label, t, g in (("sync refill", t_sync, g_sync),
+                        ("staged admission", t_staged, g_staged)):
+        print(f"\n  [{label}] wall {t:.2f}s, chunks {g.get('chunks')}, "
+              f"refills {g.get('refills')}")
+        print(f"    host_wait_ms   {g.get('host_wait_ms')}")
+        print(f"    device_idle_ms {g.get('device_idle_ms')} "
+              f"(bubble_frac {g.get('bubble_frac')})")
+        if label.startswith("staged"):
+            print(f"    stages/admits  {g.get('stages')}/{g.get('admits')} "
+                  f"(pool high-water {g.get('stage_inflight')})")
+            print(f"    admit_wait_ms  {g.get('admit_wait_ms')} "
+                  f"(stall: demand arrived before staging)")
+            print(f"    overlap_frac   {g.get('prefill_overlap_frac')} "
+                  f"(rows staged behind an in-flight chunk)")
+            print(f"    suffix_buckets {g.get('suffix_buckets')} "
+                  f"(vs queue-wide Ss={g.get('suffix_len')})")
+    print(f"\n  speedup {t_sync / max(t_staged, 1e-9):.2f}x, "
+          f"outputs identical: {o_sync == o_staged}")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=384)
@@ -33,6 +107,12 @@ def main() -> None:
                     help="stream phase-span JSONL here (default: in-memory)")
     ap.add_argument("--hbm-budget-frac", type=float, default=0.9,
                     help="AOT HBM preflight budget fraction; 0 disables")
+    ap.add_argument("--stage-breakdown", action="store_true",
+                    help="instead of an op trace, A/B the continuous "
+                         "scheduler with staged admission off/on over a "
+                         "churny mixed-budget queue and print where the "
+                         "admission time goes (host wait, device idle, "
+                         "admit stall, stage/decode overlap)")
     args = ap.parse_args()
 
     import jax
@@ -78,6 +158,11 @@ def main() -> None:
     )
 
     from bench import _build_workload
+
+    if args.stage_breakdown:
+        _stage_breakdown(runner, cfg, tok, args, ledger)
+        ledger.close()
+        return
 
     prompts, vecs, starts = _build_workload(cfg, tok, args.batch)
 

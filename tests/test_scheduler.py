@@ -210,7 +210,8 @@ def test_filler_rows_emit_only_pad(runner_noprefix, monkeypatch):
 def test_scheduler_fallback_is_batch_path(runner):
     """No shared prefix => the continuous path falls back to fixed batches:
     uniform budgets produce the batch path's exact output, and a
-    mixed-budget queue (inexpressible per-batch) raises."""
+    mixed-budget queue is served by grouping trials per budget (one batch
+    call per group — see test_staged_prefill for the row-level check)."""
     prompts = ["Alpha prompt one", "Beta prompt two", "Gamma prompt three"]
     rng = np.random.default_rng(3)
     vecs = [rng.standard_normal(runner.cfg.hidden_size).astype(np.float32)
@@ -228,11 +229,22 @@ def test_scheduler_fallback_is_batch_path(runner):
             strengths[i:i + 2], max_new_tokens=8, temperature=0.0, seed=0,
         ))
     assert sched == ref
-    with pytest.raises(ValueError, match="non-uniform"):
-        runner.generate_grid_scheduled(
-            prompts, layers, vecs, strengths, max_new_tokens=8,
-            temperature=0.0, budgets=[2, 8, 8], seed=0, slots=2,
+    budgets = [2, 8, 8]
+    mixed = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, max_new_tokens=8,
+        temperature=0.0, budgets=budgets, seed=0, slots=2,
+    )
+    gref = [None] * 3
+    for b in sorted(set(budgets)):
+        idx = [i for i in range(3) if budgets[i] == b]
+        out = runner.generate_batch_with_grid_steering(
+            [prompts[i] for i in idx], [layers[i] for i in idx],
+            [vecs[i] for i in idx], [strengths[i] for i in idx],
+            max_new_tokens=b, temperature=0.0, seed=0,
         )
+        for j, i in enumerate(idx):
+            gref[i] = out[j]
+    assert mixed == gref
 
 
 def test_run_grid_pass_continuous_matches_batch(runner):

@@ -1,0 +1,221 @@
+"""Staged admission: bit-identity with the synchronous refill loop (greedy
+and sampled, across slot counts, chunk sizes, and suffix-bucket widths),
+stats equality on budget-forced queues, and the budget-grouped fixed-batch
+fallback matching per-budget batch calls."""
+
+import jax
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.models import (
+    ByteTokenizer,
+    init_params,
+    tiny_config,
+)
+from introspective_awareness_tpu.obs import RunLedger
+from introspective_awareness_tpu.runtime import ModelRunner
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def runner(setup):
+    cfg, params = setup
+    return ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4,
+    )
+
+
+COMMON = "The quick brown fox jumps over the lazy dog. " * 4
+
+
+def _queue(n, hidden):
+    """Same shape as test_scheduler._queue: shared preamble, ragged suffixes,
+    a strength-0 row every third trial, steer starts inside the padding."""
+    prompts, starts, strengths, layers = [], [], [], []
+    for i in range(n):
+        p = (
+            COMMON
+            + f"Trial {i + 1}: Do you detect an injected thought"
+            + "?" * (i % 3 + 1)
+        )
+        prompts.append(p)
+        if i % 3 == 2:
+            strengths.append(0.0)
+            starts.append(None)
+        else:
+            strengths.append(6.0 + i)
+            starts.append(len(p) - 10)
+        layers.append(1 + i % 2)
+    rng = np.random.default_rng(7)
+    vecs = [rng.standard_normal(hidden).astype(np.float32) * 4.0
+            for _ in range(n)]
+    return prompts, layers, vecs, strengths, starts
+
+
+def test_staged_matches_sync_greedy_mixed_budgets(runner):
+    """The tentpole identity guarantee: staged rows are prefilled at a
+    narrower bucketed width against the prefix KV, then scattered into the
+    same physical cache slots the sync refill would have written — greedy
+    text must be bit-identical across slot counts on a mixed-budget queue
+    that forces mid-flight admissions."""
+    N = 8
+    prompts, layers, vecs, strengths, starts = _queue(N, runner.cfg.hidden_size)
+    budgets = [3, 12, 6, 12, 3, 8, 12, 5]
+    kw = dict(
+        max_new_tokens=12, temperature=0.0,
+        steering_start_positions=starts, budgets=budgets, seed=0,
+    )
+    for slots in (2, 3):
+        sync = runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, slots=slots, staged=False, **kw
+        )
+        staged = runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, slots=slots, staged=True, **kw
+        )
+        assert staged == sync, f"staged admission diverged at slots={slots}"
+
+
+def test_staged_matches_sync_sampled(runner):
+    """temp > 0: the per-trial PRNG is queue-indexed, so sampled text must
+    be invariant to the slot count AND the admission mechanism — staging
+    changes when/at what width a trial is prefilled, never its key."""
+    prompts, layers, vecs, strengths, starts = _queue(6, runner.cfg.hidden_size)
+    kw = dict(
+        max_new_tokens=10, temperature=0.9,
+        steering_start_positions=starts, seed=11,
+    )
+    outs = [
+        runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, slots=slots, staged=st, **kw
+        )
+        for slots in (2, 4)
+        for st in (False, True)
+    ]
+    assert all(o == outs[0] for o in outs[1:])
+
+
+def test_staged_chunk_size_invariance(runner, monkeypatch):
+    """Chunk size changes both the decode cadence and WHEN admission demand
+    arises (and therefore how staging interleaves with decode); output must
+    not notice."""
+    from introspective_awareness_tpu.runtime import generate as gen
+
+    prompts, layers, vecs, strengths, starts = _queue(5, runner.cfg.hidden_size)
+    budgets = [4, 12, 7, 12, 3]
+
+    def run(staged):
+        return runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, max_new_tokens=12,
+            temperature=0.0, steering_start_positions=starts,
+            budgets=budgets, seed=0, slots=2, staged=staged,
+        )
+
+    monkeypatch.setattr(gen, "RING_CHUNK", 4)
+    fine_sync, fine_staged = run(False), run(True)
+    monkeypatch.setattr(gen, "RING_CHUNK", 16)
+    coarse_staged = run(True)
+    assert fine_staged == fine_sync
+    assert coarse_staged == fine_sync
+
+
+def test_staged_suffix_bucket_invariance(runner):
+    """The bucket quantum only sets the padded stage width Sb: real tokens
+    are left-packed into the Sb window and land at the same physical slots
+    after the admit scatter, so a tiny quantum (many narrow stages), a huge
+    one (Sb == Ss always), and disabled bucketing must all emit identical
+    text — staged or not."""
+    prompts, layers, vecs, strengths, starts = _queue(7, runner.cfg.hidden_size)
+    budgets = [3, 10, 5, 10, 3, 7, 10]
+
+    def run(staged, bucket):
+        return runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, max_new_tokens=10,
+            temperature=0.0, steering_start_positions=starts,
+            budgets=budgets, seed=0, slots=3, staged=staged,
+            suffix_bucket=bucket,
+        )
+
+    ref = run(False, 16)
+    for bucket in (4, 16, 4096, 0):
+        assert run(True, bucket) == ref, f"diverged at suffix_bucket={bucket}"
+
+
+def test_staged_stats_preserved(setup):
+    """Admission accounting: staging changes WHERE the suffix forward runs,
+    not the slot occupancy timeline — on a budget-forced queue the staged
+    loop admits the same trials into the same slots at the same chunk
+    boundaries as the sync loop, so chunks/occupancy/waste must be EQUAL,
+    and the staged leg must report its gauges (stages cover the queue,
+    admits happened, every staged row is bucket-accounted)."""
+    cfg, params = setup
+    ledger = RunLedger(path=None)
+    runner = ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4, ledger=ledger,
+    )
+    N = 6
+    prompts, layers, vecs, strengths, starts = _queue(N, cfg.hidden_size)
+    budgets = [4, 9, 12, 3, 6, 9]
+
+    def stats(staged):
+        out = runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, max_new_tokens=12,
+            temperature=0.0, steering_start_positions=starts,
+            budgets=budgets, seed=0, slots=3, staged=staged,
+        )
+        spans = [
+            e for e in ledger.events
+            if e.get("ev") == "span" and e.get("phase") == "generate_scheduled"
+        ]
+        return out, spans[-1]
+
+    sync_out, s = stats(False)
+    staged_out, p = stats(True)
+    assert staged_out == sync_out
+    assert s["staged"] is False and p["staged"] is True
+    for key in ("chunks", "mean_slot_occupancy", "padded_row_waste_steps"):
+        assert p[key] == s[key], f"{key}: staged {p[key]} != sync {s[key]}"
+    assert p["staged_rows"] == N
+    assert p["stages"] >= 1 and p["admits"] >= 1
+    assert sum(p["suffix_buckets"].values()) == N
+    assert s["stages"] == 0 and s["admits"] == 0
+
+
+def test_fallback_budget_grouping_matches_batch(runner):
+    """No shared prefix => the scheduler falls back to fixed batches. With
+    mixed budgets it must group trials by budget and match per-budget
+    generate_batch_with_grid_steering calls row-for-row (greedy)."""
+    hidden = runner.cfg.hidden_size
+    prompts = [f"Totally distinct prompt number {i}!" * (i + 1)
+               for i in range(5)]
+    layers = [1 + i % 2 for i in range(5)]
+    rng = np.random.default_rng(3)
+    vecs = [rng.standard_normal(hidden).astype(np.float32) * 4.0
+            for _ in range(5)]
+    strengths = [5.0, 0.0, 6.0, 7.0, 0.0]
+    budgets = [4, 9, 4, 9, 6]
+
+    out = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, max_new_tokens=12,
+        temperature=0.0, budgets=budgets, seed=0, slots=4,
+    )
+    assert len(out) == 5 and all(isinstance(t, str) for t in out)
+
+    expect = [None] * 5
+    for b in sorted(set(budgets)):
+        idx = [i for i in range(5) if budgets[i] == b]
+        ref = runner.generate_batch_with_grid_steering(
+            [prompts[i] for i in idx], [layers[i] for i in idx],
+            [vecs[i] for i in idx], [strengths[i] for i in idx],
+            max_new_tokens=b, temperature=0.0, seed=0,
+        )
+        for j, i in enumerate(idx):
+            expect[i] = ref[j]
+    assert out == expect
