@@ -566,6 +566,109 @@ def _staged_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     return r
 
 
+def _durability_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
+    """Kill-and-resume round trip through the trial journal.
+
+    One uninterrupted continuous-scheduler pass is the reference; a second
+    pass runs with a journal attached and a deterministic FaultPlan that
+    crashes the host loop one chunk after the first decode cohort finalizes
+    (``_chunk_plan(max_new)[0] + 1``), then the harness shears the journal's
+    final record mid-line the way a kill mid-``write`` does. The resumed
+    pass replays the journal, re-enqueues only the remainder on its original
+    queue-indexed PRNG streams, and must reproduce the reference outputs
+    bit-identically — at temperature 1, which is the strong form of the
+    claim. ``resume_speedup`` is the wall-clock ratio of the reference pass
+    to the resumed remainder: the work the journal saved.
+    """
+    import tempfile
+    import time as _time
+    from pathlib import Path
+
+    from introspective_awareness_tpu.protocol.trials import run_grid_pass
+    from introspective_awareness_tpu.runtime.faults import FaultPlan, InjectedCrash
+    from introspective_awareness_tpu.runtime.generate import _chunk_plan
+    from introspective_awareness_tpu.runtime.journal import TrialJournal
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    runner = ModelRunner(
+        runner.params, cfg, tok, model_name="bench-durability",
+        seq_multiple=16, batch_multiple=slots, ledger=ledger,
+    )
+    rng = np.random.default_rng(5)
+    concepts = ("Dust", "Trees")
+    n_per = max(1, slots)  # 2 concepts x slots trials = 2 decode cohorts
+    layer_idx = int(cfg.n_layers * 0.6)
+    tasks = [
+        (c, t, 0.6, layer_idx, 4.0)
+        for c in concepts for t in range(1, n_per + 1)
+    ]
+    vecs = {
+        c: rng.normal(size=cfg.hidden_size).astype(np.float32)
+        for c in concepts
+    }
+    kw = dict(
+        max_new_tokens=max_new, temperature=1.0, batch_size=slots,
+        seed=17, scheduler="continuous",
+    )
+
+    def run(**extra):
+        return run_grid_pass(
+            runner, "injection", tasks, lambda lf, c: vecs[c], **kw, **extra
+        )
+
+    run()  # warm compile
+    t0 = _time.perf_counter()
+    ref = run()
+    t_ref = _time.perf_counter() - t0
+
+    # A cohort admitted together finalizes by chunk n_chunks; crashing one
+    # chunk later guarantees journaled progress on any backend/chunk plan.
+    crash_after = _chunk_plan(max_new)[0] + 1
+    r: dict = {
+        "queue_trials": len(tasks), "slots": slots,
+        "crash_after_chunks": crash_after, "ref_time_s": round(t_ref, 3),
+    }
+    with tempfile.TemporaryDirectory() as td:
+        jpath = Path(td) / "trial_journal.jsonl"
+        sig = {"bench": "durability", "n": len(tasks), "max_new": max_new}
+        journal = TrialJournal(jpath, sig)
+        faults = FaultPlan(crash_after_chunks=crash_after, torn_tail=1)
+        crashed = False
+        try:
+            run(journal=journal, pass_key="bench", faults=faults)
+        except InjectedCrash:
+            crashed = True
+        journal.close()
+        r["crashed"] = crashed
+        r["torn_bytes"] = faults.tear_tail(jpath)
+
+        t0 = _time.perf_counter()
+        resumed = TrialJournal(jpath, sig)
+        out = run(journal=resumed, pass_key="bench")
+        t_resume = _time.perf_counter() - t0
+        g = resumed.gauges
+        r.update({
+            "outputs_identical": out == ref,
+            "recovered_trials": g.recovered_trials,
+            "requeued_trials": g.requeued_trials,
+            "torn_records_dropped": g.torn_records_dropped,
+            "replayed_records": g.replayed_records,
+            "resume_time_s": round(t_resume, 3),
+            "resume_speedup": (
+                round(t_ref / t_resume, 3) if t_resume > 0 else None
+            ),
+        })
+        resumed.discard()
+    log(
+        f"  [durability] {len(tasks)} trials x {slots} slots: crash@chunk "
+        f"{crash_after} + torn tail -> {r['recovered_trials']} recovered, "
+        f"{r['requeued_trials']} requeued, identical="
+        f"{r['outputs_identical']}, resume {r['resume_time_s']}s vs full "
+        f"{r['ref_time_s']}s"
+    )
+    return r
+
+
 def _hbm_model(runner, cfg, batch, prompt_len, max_new) -> float:
     """Modeled HBM bytes read per decode step: every parameter once + the
     full KV-cache buffer (the decode attention reads all T slots each step
@@ -686,6 +789,9 @@ def main() -> None:
 
     # ---- staged admission vs synchronous refill (churny queue) -------------
     stg = _staged_compare(runner, cfg, tok, batches[0], max_new, ledger)
+
+    # ---- crash + torn tail + resume through the trial journal --------------
+    dur = _durability_compare(runner, cfg, tok, batches[0], max_new, ledger)
 
     # ---- int8 weight-quantized variant at the best bf16 batch --------------
     if on_tpu:
@@ -875,6 +981,7 @@ def main() -> None:
         "scheduler": sched,
         "pipeline": pipe,
         "staged_prefill": stg,
+        "durability": dur,
         "phases": ledger.summary().get("phases", {}),
         "hbm_preflight": preflight_verdict,
         "hbm_devices": hbm_devices,

@@ -153,6 +153,22 @@ def build_parser() -> argparse.ArgumentParser:
                              "memory_analysis() footprint exceeds this "
                              "fraction of per-device HBM (e.g. 0.9), naming "
                              "the largest temp buffers. Default off.")
+    parser.add_argument("--journal", type=str, default="auto",
+                        help="Trial-level durability journal (crash-safe "
+                             "resume at trial granularity, bit-identical to "
+                             "an uninterrupted run): 'auto' writes "
+                             "<model-dir>/trial_journal.jsonl when "
+                             "--scheduler continuous is active, 'off' "
+                             "disables (resume stays cell-granular via "
+                             "results.json markers), else an explicit path")
+    parser.add_argument("--inject-faults", type=str, default=None,
+                        help="Deterministic fault injection for testing "
+                             "recovery (also via IAT_FAULTS env): comma "
+                             "spec like 'crash_after_chunks=3,"
+                             "judge_timeout=2,torn_tail'. Knobs: "
+                             "crash_after_chunks, crash_on_admission, "
+                             "judge_timeout, judge_rate_limit, judge_5xx, "
+                             "torn_tail. Never set in production runs.")
     return parser
 
 

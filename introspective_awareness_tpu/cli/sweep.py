@@ -15,11 +15,19 @@ around the TPU runtime's strengths:
 - Each model run writes a ``run_manifest.json`` (mesh shape, device/chip
   info, phase timings) — the machine-readable observability artifact
   (SURVEY.md §5.5 plan).
+- Crash safety below the cell: with ``--scheduler continuous`` a trial
+  journal (``runtime.journal``) records every decoded/graded trial, so a
+  preemption mid-sweep resumes at TRIAL granularity with bit-identical
+  final artifacts. SIGTERM/SIGINT drain in-flight chunks and exit 130 with
+  a clean-stop marker; a judge outage defers grading to the journal and the
+  sweep finishes decode-complete, re-grading post-hoc on resume.
 """
 
 from __future__ import annotations
 
 import json
+import signal
+import threading
 import time
 from pathlib import Path
 from typing import Optional
@@ -37,6 +45,12 @@ from introspective_awareness_tpu.metrics import (
 from introspective_awareness_tpu.judge.judge import reconstruct_trial_prompts
 from introspective_awareness_tpu.models.registry import get_layer_at_fraction
 from introspective_awareness_tpu.protocol.trials import run_grid_pass, run_trial_pass
+from introspective_awareness_tpu.runtime.faults import FaultPlan
+from introspective_awareness_tpu.runtime.journal import (
+    JournalConfigMismatch,
+    SweepInterrupted,
+    TrialJournal,
+)
 from introspective_awareness_tpu.vectors import (
     extract_concept_vectors_all_layers,
     get_baseline_words,
@@ -173,6 +187,104 @@ def load_subject(name: str, args, mesh, rules):
     ))
 
 
+def _journal_config(args, model_name: str) -> dict:
+    """The grid-identity signature stamped into the journal's start record.
+
+    Everything that changes WHICH trials exist or WHAT their outputs are:
+    model, concepts, sweep axes, trial counts, decode params, seed. Perf
+    knobs the outputs are invariant to (batch_size/slot count, staged
+    prefill, pipelining) are deliberately excluded — resuming on a
+    different pod shape is the point of the journal.
+    """
+    return {
+        "model": model_name,
+        "concepts": list(args.concepts),
+        "layer_sweep": [float(lf) for lf in args.layer_sweep],
+        "strength_sweep": [float(s) for s in args.strength_sweep],
+        "n_trials": int(args.n_trials),
+        "max_tokens": int(args.max_tokens),
+        "temperature": float(args.temperature),
+        "seed": int(args.seed),
+        "scheduler": args.scheduler,
+        "extraction_method": args.extraction_method,
+    }
+
+
+def _open_journal(args, model_name: str):
+    """Open (or resume) the model's trial journal; None when disabled.
+
+    The journal rides on the continuous scheduler's per-trial completion
+    events — under ``--scheduler batch`` there is nothing finer than a cell
+    to journal, so 'auto' resolves to off there.
+    """
+    if args.journal == "off" or args.scheduler != "continuous":
+        return None
+    if args.journal == "auto":
+        path = (
+            Path(args.output_dir) / model_name.replace("/", "_")
+            / "trial_journal.jsonl"
+        )
+    else:
+        path = Path(args.journal)
+    if args.overwrite and path.exists():
+        path.unlink()
+    t0 = time.perf_counter()
+    journal = TrialJournal(path, _journal_config(args, model_name))
+    if journal.resumed:
+        # Rotate the replayed journal down to live state before appending
+        # this run's records on top.
+        journal.compact()
+        g = journal.gauges
+        print(
+            f"  resuming from trial journal: {g.recovered_trials} trials "
+            f"recovered ({g.recovered_grades} graded, "
+            f"{g.deferred_grades} deferred, "
+            f"{g.torn_records_dropped} torn records dropped"
+            f"{', clean stop' if journal.was_clean_stop else ''})"
+        )
+    journal.gauges.resume_wall_s = round(time.perf_counter() - t0, 4)
+    return journal
+
+
+def _regrade_deferred(args, judge, model_name: str, journal) -> dict:
+    """Post-hoc grading of cells whose streaming grades were deferred.
+
+    Text-in/text-out: loads each deferred cell's saved results.json,
+    judges only the rows without an ``evaluations`` entry, and rewrites
+    the cell artifacts — no subject model load, no regeneration. Cells
+    that grade cleanly are marked resolved in the journal.
+    """
+    regraded: dict = {}
+    for lf, strength in sorted(journal.deferred_cells()):
+        cell_dir = config_dir(args.output_dir, model_name, lf, strength)
+        results_file = cell_dir / "results.json"
+        if not results_file.exists():
+            # The sweep never reached this cell's save (crash before the
+            # fused save loop); its trials re-enter via the decoded journal
+            # instead.
+            continue
+        with open(results_file) as f:
+            saved = json.load(f)
+        results = saved.get("results", [])
+        layer_idx = saved.get("metrics", {}).get("layer_idx", -1)
+        before = sum(1 for r in results if "evaluations" not in r)
+        metrics = _cell_metrics(
+            results, judge, args, lf, layer_idx, strength, skip_graded=True
+        )
+        after = sum(1 for r in results if "evaluations" not in r)
+        _save_cell(results, metrics, cell_dir, model_name)
+        regraded[(lf, strength)] = {"results": results, **metrics}
+        if after == 0:
+            journal.record_cell_regraded((lf, strength))
+            journal.gauges.regraded_deferred += before - after
+            print(f"  re-graded deferred L={lf:.2f} S={strength} "
+                  f"({before} trials)")
+        else:
+            print(f"  deferred L={lf:.2f} S={strength}: judge still "
+                  f"unavailable ({after} trials remain ungraded)")
+    return regraded
+
+
 def run_sweep(args, runner, judge, model_name: str) -> dict:
     """All (layer, strength) cells for one loaded model. Returns
     ``{(layer_frac, strength): {"results": ..., <metrics>}}`` for plotting."""
@@ -184,6 +296,10 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
     timings: dict[str, float] = {}
     ledger = runner.ledger
     compile_before = CompileAccounting.install().snapshot()
+    journal = getattr(args, "_journal", None)
+    stop_event = getattr(args, "_stop_event", None)
+    faults = getattr(args, "_faults", None)
+    breaker = getattr(args, "_judge_breaker", None)
 
     # ---- vectors for every swept layer, one capture pass ------------------
     t0 = time.perf_counter()
@@ -271,12 +387,15 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
         and getattr(judge.client, "overlap_safe", True)
     )
 
-    def _make_pool():
+    def _make_pool(pass_key: Optional[str] = None):
         if not stream_grading:
             return None
         from introspective_awareness_tpu.judge import StreamingGradePool
 
-        return StreamingGradePool(judge)
+        return StreamingGradePool(
+            judge, journal=journal, pass_key=pass_key,
+            faults=faults, breaker=breaker,
+        )
 
     if pending and fuse:
         # ---- fused: rows of ALL pending cells pack into shared batches ----
@@ -306,12 +425,15 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 # must not record a ~0s timing: it would masquerade as the
                 # compile-carrying first pass and skew the warm-rate fields.
                 continue
+            pass_key = f"fused/{trial_type}"
             out = run_grid_pass(
                 runner, trial_type, tasks, vector_lookup,
                 max_new_tokens=args.max_tokens, temperature=args.temperature,
                 batch_size=args.batch_size, seed=args.seed + k * 1_000_003,
                 scheduler=args.scheduler, staged=args.staged_prefill,
-                grade_pool=_make_pool(),
+                grade_pool=_make_pool(pass_key),
+                journal=journal, pass_key=pass_key,
+                stop_event=stop_event, faults=faults,
             )
             fused += out
             # Pass-granular timings: the fused grid has no per-cell unit of
@@ -363,9 +485,13 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             results = []
             for trial_type, trial_nums in trial_plan:
                 tasks = [(c, t) for c in args.concepts for t in trial_nums]
+                pass_key = f"cell/{lf:.2f}/{strength}/{trial_type}"
                 results += run_trial_pass(
                     runner, trial_type, tasks,
-                    grade_pool=_make_pool(), **common,
+                    grade_pool=_make_pool(pass_key),
+                    journal=journal, pass_key=pass_key,
+                    stop_event=stop_event, faults=faults,
+                    **common,
                 )
             t_cell = time.perf_counter() - t0
             t_gen += t_cell
@@ -426,6 +552,31 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             # Back-compat alias for manifest consumers written against the
             # per-cell field name.
             timings["generation_cells_s"] = cell_times
+    if journal is not None:
+        # Resolve any grading the pool deferred (judge outage mid-sweep):
+        # the sweep is decode-complete, so this is text-only re-grading.
+        if journal.deferred_cells() and judge is not None:
+            all_results.update(
+                _regrade_deferred(args, judge, model_name, journal)
+            )
+        timings["recovery"] = journal.gauges.as_stats()
+        ledger.event("recovery", model=model_name,
+                     **journal.gauges.as_stats())
+        if journal.deferred_cells():
+            # Keep the journal (compacted) so a later run with a healthy
+            # judge can finish the deferred grading without regenerating.
+            journal.compact()
+            journal.close()
+            print(
+                f"  note: {len(journal.deferred_cells())} cell(s) have "
+                f"deferred grading; journal kept — rerun when the judge "
+                f"recovers"
+            )
+        else:
+            # Every trial is persisted in final artifacts; the journal has
+            # nothing left to recover.
+            journal.discard()
+            args._journal = None
     _write_manifest(
         out_base, args, runner, timings,
         judge=judge, compile_before=compile_before,
@@ -446,7 +597,32 @@ def _cell_metrics(
     from introspective_awareness_tpu.obs import NullLedger
 
     ledger = getattr(args, "_ledger", None) or NullLedger()
-    if judge is not None:
+    breaker = getattr(args, "_judge_breaker", None)
+    journal = getattr(args, "_journal", None)
+
+    def _degrade(error: str, detail: str) -> dict:
+        """Structured degradation: ledger event + journal deferral so the
+        ungraded rows are owed (and re-graded) on resume, then keyword
+        metrics so the cell's responses are never lost."""
+        print(f"  judge failed ({error}: {detail}); keyword metrics")
+        ledger.event(
+            "grade_degraded", pass_key="posthoc", error=error,
+            detail=detail[:200], cell=f"{lf}/{strength}",
+            trials=sum(1 for r in results if "evaluations" not in r),
+            attempt=1,
+        )
+        if journal is not None:
+            journal.record_deferred(
+                "posthoc", -1, f"{error}: {detail[:200]}", 1,
+                cell=(lf, strength),
+            )
+        return _keyword_metrics(results)
+
+    if judge is not None and breaker is not None and breaker.state == "open":
+        # The streaming pool already established the judge is down; don't
+        # burn another retry ladder per cell.
+        metrics = _degrade("CircuitOpen", "judge circuit open")
+    elif judge is not None:
         try:
             if skip_graded:
                 todo = [
@@ -461,6 +637,8 @@ def _cell_metrics(
                 )
                 for i, ev in zip(todo, evaluated):
                     results[i] = ev
+                if breaker is not None:
+                    breaker.record_success()
             evaluated = list(results)
             with ledger.span("grade", evals=len(evaluated), cell=f"{lf}/{strength}"):
                 metrics = compute_detection_and_identification_metrics(evaluated)
@@ -470,8 +648,9 @@ def _cell_metrics(
             # must be distinguishable from reordered grading.
             metrics["judge_prompt_order"] = judge.prompt_order
         except Exception as e:  # noqa: BLE001 - degrade, don't lose responses
-            print(f"  judge failed ({e}); keyword metrics")
-            metrics = _keyword_metrics(results)
+            if breaker is not None:
+                breaker.record_failure()
+            metrics = _degrade(type(e).__name__, str(e))
     else:
         with ledger.span("grade", evals=len(results), cell=f"{lf}/{strength}"):
             metrics = _keyword_metrics(results)
@@ -674,6 +853,43 @@ def main(argv: Optional[list[str]] = None) -> int:
     from introspective_awareness_tpu.parallel import MeshConfig, ShardingRules, build_mesh
 
     args = parse_args(argv)
+
+    # Fault injection (test/CI harness only): --inject-faults wins over the
+    # IAT_FAULTS env var; both absent → None (zero overhead on hot paths).
+    args._faults = (
+        FaultPlan.from_spec(args.inject_faults) if args.inject_faults
+        else FaultPlan.from_env()
+    )
+
+    # Graceful shutdown: first SIGTERM/SIGINT sets the stop event — the
+    # scheduler drains in-flight chunks, the journal flushes, and main exits
+    # 130 with a clean-stop marker. The original handler is restored so a
+    # second signal kills the process the default way.
+    stop_event = threading.Event()
+    args._stop_event = stop_event
+
+    def _install_signal_handlers():
+        originals = {}
+
+        def _graceful(signum, frame):
+            print(
+                f"\nreceived signal {signum}: draining in-flight work and "
+                f"flushing the trial journal (signal again to force-kill)"
+            )
+            stop_event.set()
+            for sig, orig in originals.items():
+                signal.signal(sig, orig)
+
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                originals[sig] = signal.signal(sig, _graceful)
+        except ValueError:
+            # Not the main thread (embedded use): rely on the caller
+            # setting args._stop_event directly.
+            pass
+
+    _install_signal_handlers()
+
     if args.debug_nans:
         from introspective_awareness_tpu.utils import enable_debug_checks
 
@@ -741,10 +957,24 @@ def main(argv: Optional[list[str]] = None) -> int:
     judge = _build_judge(args, mesh, rules)
     if judge is not None:
         judge.ledger = ledger
+    # One circuit breaker shared by every grade pool and the post-hoc
+    # grading path: a dead judge trips it once, sweep-wide.
+    if judge is not None:
+        from introspective_awareness_tpu.judge import CircuitBreaker
+
+        args._judge_breaker = CircuitBreaker()
+    else:
+        args._judge_breaker = None
 
     for model_name in models:
         print(f"=== {model_name} ===")
         out_base = Path(args.output_dir) / model_name.replace("/", "_")
+
+        try:
+            args._journal = _open_journal(args, model_name)
+        except JournalConfigMismatch as e:
+            print(f"error: {e}")
+            return 2
 
         # Fast path: every cell done and no re-eval → no model load at all
         # (reference :1372-1506).
@@ -758,7 +988,18 @@ def main(argv: Optional[list[str]] = None) -> int:
                 print("  all cells complete; re-judging without model load")
                 all_results = _rejudge_cells(args, judge, model_name)
             else:
-                print("  all cells complete; skipping model load")
+                journal = args._journal
+                if (
+                    journal is not None
+                    and journal.deferred_cells()
+                    and judge is not None
+                ):
+                    # Decode finished last run but a judge outage deferred
+                    # grading: resolve it text-only, no model load.
+                    print("  all cells complete; grading deferred trials")
+                    _regrade_deferred(args, judge, model_name, journal)
+                else:
+                    print("  all cells complete; skipping model load")
                 all_results = {}
                 for lf in args.layer_sweep:
                     for s in args.strength_sweep:
@@ -767,6 +1008,15 @@ def main(argv: Optional[list[str]] = None) -> int:
                         all_results[(lf, s)] = {
                             "results": saved.get("results", []), **saved.get("metrics", {})
                         }
+            journal = args._journal
+            if journal is not None:
+                if journal.deferred_cells():
+                    journal.compact()
+                    journal.flush()
+                    journal.close()
+                else:
+                    journal.discard()
+                args._journal = None
         else:
             from introspective_awareness_tpu.utils import profile_trace
 
@@ -774,10 +1024,27 @@ def main(argv: Optional[list[str]] = None) -> int:
                 runner = load_subject(model_name, args, mesh, rules)
             runner.ledger = ledger
             runner.hbm_budget_frac = args.hbm_budget_frac
-            with profile_trace(args.profile_dir):
-                all_results = run_sweep(args, runner, judge, model_name)
+            try:
+                with profile_trace(args.profile_dir):
+                    all_results = run_sweep(args, runner, judge, model_name)
+            except SweepInterrupted as e:
+                journal = args._journal
+                if journal is not None:
+                    journal.record_clean_stop()
+                    journal.close()
+                    print(
+                        f"  sweep interrupted ({e}); journal flushed to "
+                        f"{journal.path} — rerun the same command to resume"
+                    )
+                else:
+                    print(
+                        f"  sweep interrupted ({e}); completed cells are "
+                        f"saved — rerun the same command to resume"
+                    )
+                return 130
             write_debug_dumps(out_base, runner, args, all_results)
             runner.cleanup()
+            args._journal = None
 
         create_sweep_plots(
             all_results, args.concepts, args.layer_sweep, args.strength_sweep, out_base

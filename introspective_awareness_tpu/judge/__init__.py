@@ -32,7 +32,10 @@ from introspective_awareness_tpu.judge.judge import (
     batch_evaluate,
     reconstruct_trial_prompts,
 )
-from introspective_awareness_tpu.judge.streaming import StreamingGradePool
+from introspective_awareness_tpu.judge.streaming import (
+    CircuitBreaker,
+    StreamingGradePool,
+)
 
 __all__ = [
     "AFFIRMATIVE_RESPONSE_CRITERIA",
@@ -48,6 +51,7 @@ __all__ = [
     "load_dotenv",
     "parse_grade",
     "parse_yes_no",
+    "CircuitBreaker",
     "LLMJudge",
     "StreamingGradePool",
     "batch_evaluate",

@@ -10,7 +10,33 @@ from __future__ import annotations
 
 import asyncio
 import os
+import random
 from typing import Optional, Protocol, Sequence
+
+
+def _retry_after_seconds(exc: Exception) -> Optional[float]:
+    """Extract a usable ``Retry-After`` value from an API error, if any.
+
+    OpenAI-compatible servers attach the header to 429/503 responses;
+    honoring it beats guessing with exponential backoff. Returns seconds
+    (clamped to [0, 120]) or ``None`` when absent/unparseable. Only the
+    delta-seconds form is handled — HTTP-date values are rare on these
+    APIs and a wrong parse would oversleep.
+    """
+    response = getattr(exc, "response", None)
+    headers = getattr(response, "headers", None)
+    if headers is None:
+        return None
+    try:
+        raw = headers.get("retry-after") or headers.get("Retry-After")
+    except Exception:  # noqa: BLE001 - exotic mapping types
+        return None
+    if raw is None:
+        return None
+    try:
+        return min(max(float(raw), 0.0), 120.0)
+    except (TypeError, ValueError):
+        return None
 
 
 class JudgeClient(Protocol):
@@ -122,7 +148,15 @@ class OpenAIJudgeClient:
             ) as e:
                 last_error = e
             if attempt < self.max_retries - 1:
-                await asyncio.sleep(2**attempt)
+                # Exponential backoff, lifted to the server's Retry-After
+                # when it sends one (rate limits), plus jitter so the
+                # max_concurrent in-flight requests that got 429'd together
+                # don't retry in lockstep and trip the limiter again.
+                delay: float = 2**attempt
+                retry_after = _retry_after_seconds(last_error)
+                if retry_after is not None:
+                    delay = max(delay, retry_after)
+                await asyncio.sleep(delay + random.uniform(0, 0.25 * delay))
         raise last_error  # type: ignore[misc]
 
     def grade(self, prompts: Sequence[str]) -> list[str]:

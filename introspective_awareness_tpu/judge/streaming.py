@@ -21,9 +21,20 @@ Threading contract:
   chips (and jit machinery) the scheduler is driving. It carries
   ``overlap_safe = False`` and callers must not build a pool around it —
   check ``getattr(judge.client, "overlap_safe", True)``.
-- A worker failure (API down, parse explosion) marks its items ungraded
-  and the pool keeps running; callers fall back to post-hoc grading for
-  whatever ``finish`` returns without an ``evaluations`` entry.
+- A worker failure (API down, parse explosion) is retried inline up to
+  ``max_attempts`` times, then the batch is *deferred*: recorded in the
+  trial journal's deferred-grading queue (when a journal is attached) and
+  reported in ``finish`` stats, so the sweep finishes decode-complete and
+  grades the remainder post-hoc on resume. Each failure also lands as a
+  structured ``degraded`` record (exception type, trial ids, attempt) the
+  caller turns into ``grade_degraded`` ledger events after ``finish``.
+- The shared :class:`CircuitBreaker` stops the pool from burning retries
+  against a judge that is down: after ``failure_threshold`` consecutive
+  failures it opens, batches defer immediately instead of calling out,
+  and after ``cooldown_s`` one half-open probe decides whether to close.
+- The :class:`~introspective_awareness_tpu.runtime.journal.TrialJournal`
+  *is* thread-safe (internal lock), so workers append graded/deferred
+  records directly; the run ledger still is not — workers never touch it.
 """
 
 from __future__ import annotations
@@ -41,6 +52,60 @@ from introspective_awareness_tpu.judge.judge import (
 _STOP = object()
 
 
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker shared across grade pools.
+
+    States: *closed* (calls flow), *open* (calls rejected until
+    ``cooldown_s`` since the trip), *half-open* (one probe allowed; its
+    outcome closes or re-opens the circuit). ``allow()`` is asked before
+    every judge call; callers that get ``False`` defer instead of calling.
+    Thread-safe — one instance is shared by every pool and the post-hoc
+    grading path of a sweep, so a dead judge trips it once, sweep-wide.
+    """
+
+    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 30.0):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_at is None:
+                return "closed"
+            if time.monotonic() - self._opened_at >= self.cooldown_s:
+                return "half-open"
+            return "open"
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if time.monotonic() - self._opened_at < self.cooldown_s:
+                return False
+            # Half-open: exactly one in-flight probe at a time.
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probing = False
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._opened_at = time.monotonic()
+
+
 class StreamingGradePool:
     """Bounded worker pool grading a stream of finished trial results.
 
@@ -53,15 +118,32 @@ class StreamingGradePool:
     """
 
     def __init__(
-        self, judge: LLMJudge, max_workers: int = 4, max_batch: int = 8
+        self,
+        judge: LLMJudge,
+        max_workers: int = 4,
+        max_batch: int = 8,
+        journal=None,
+        pass_key: Optional[str] = None,
+        faults=None,
+        breaker: Optional[CircuitBreaker] = None,
+        max_attempts: int = 3,
+        retry_delay_s: float = 0.1,
     ):
         self.judge = judge
         self.max_batch = max(1, int(max_batch))
+        self.journal = journal
+        self.pass_key = pass_key
+        self.faults = faults
+        self.breaker = breaker
+        self.max_attempts = max(1, int(max_attempts))
+        self.retry_delay_s = max(0.0, float(retry_delay_s))
         self._q: queue.Queue = queue.Queue()
         self._lock = threading.Lock()
         self._graded: dict[int, dict] = {}
         self._windows: list[tuple[float, float]] = []  # per-batch (t0, t1)
         self._errors: list[str] = []
+        self._degraded: list[dict] = []  # structured failure records
+        self._deferred: list[int] = []   # queue indices pushed to post-hoc
         self._submitted = 0
         self._finished = False
         self._workers = [
@@ -104,20 +186,76 @@ class StreamingGradePool:
                 batch.append(nxt)
             idxs = [i for i, _ in batch]
             results = [r for _, r in batch]
+            self._grade_batch(idxs, results)
+
+    def _grade_batch(self, idxs: list[int], results: list[dict]) -> None:
+        """Grade one micro-batch with inline retries; defer on exhaustion.
+
+        Retrying here (rather than requeueing) keeps the ``_STOP``
+        sentinel protocol trivial: a batch never re-enters the queue after
+        ``finish`` posted sentinels.
+        """
+        attempts = 0
+        while True:
+            if self.breaker is not None and not self.breaker.allow():
+                self._defer(idxs, results, "CircuitOpen",
+                            "judge circuit open; deferring to post-hoc",
+                            attempts)
+                return
             t0 = time.perf_counter()
             try:
+                if self.faults is not None:
+                    injected = self.faults.judge_failure()
+                    if injected is not None:
+                        raise injected
                 evaluated = self.judge._evaluate_batch_inner(
                     results, reconstruct_trial_prompts(results)
                 )
             except Exception as e:  # noqa: BLE001 - degrade to post-hoc
+                attempts += 1
+                if self.breaker is not None:
+                    self.breaker.record_failure()
                 with self._lock:
                     self._errors.append(f"{type(e).__name__}: {e}")
+                    self._degraded.append({
+                        "trials": list(idxs),
+                        "error": type(e).__name__,
+                        "detail": str(e)[:200],
+                        "attempt": attempts,
+                    })
+                if attempts >= self.max_attempts:
+                    self._defer(idxs, results, type(e).__name__,
+                                str(e)[:200], attempts)
+                    return
+                if self.retry_delay_s:
+                    time.sleep(self.retry_delay_s * attempts)
                 continue
+            if self.breaker is not None:
+                self.breaker.record_success()
             t1 = time.perf_counter()
             with self._lock:
                 self._windows.append((t0, t1))
                 for i, ev in zip(idxs, evaluated):
                     self._graded[i] = ev
+            if self.journal is not None:
+                for i, ev in zip(idxs, evaluated):
+                    self.journal.record_graded(
+                        self.pass_key, i, ev["evaluations"]
+                    )
+            return
+
+    def _defer(
+        self, idxs: list[int], results: list[dict],
+        error: str, detail: str, attempts: int,
+    ) -> None:
+        with self._lock:
+            self._deferred.extend(idxs)
+        if self.journal is not None:
+            for i, r in zip(idxs, results):
+                self.journal.record_deferred(
+                    self.pass_key, i, f"{error}: {detail}", attempts,
+                    cell=(r.get("layer_fraction"), r.get("strength")),
+                )
 
     # -- join ----------------------------------------------------------------
 
@@ -153,5 +291,11 @@ class StreamingGradePool:
                 else round(overlap / busy, 4)
             ),
             "grade_errors": list(self._errors),
+            "deferred": len(self._deferred),
+            "deferred_trials": sorted(self._deferred),
+            "degraded": list(self._degraded),
+            "breaker_state": (
+                None if self.breaker is None else self.breaker.state
+            ),
         }
         return self._graded, stats

@@ -12,6 +12,7 @@ from introspective_awareness_tpu.metrics.metrics import (
     identifies_concept,
 )
 from introspective_awareness_tpu.metrics.persistence import (
+    atomic_write,
     config_dir,
     load_evaluation_results,
     load_run_manifest,
@@ -26,6 +27,7 @@ __all__ = [
     "compute_aggregate_metrics",
     "compute_detection_and_identification_metrics",
     "identifies_concept",
+    "atomic_write",
     "config_dir",
     "load_evaluation_results",
     "load_run_manifest",

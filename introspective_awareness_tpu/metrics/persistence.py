@@ -8,14 +8,49 @@ Layout matches the reference exactly (detect_injected_thoughts.py:1651-1652,
     <out>/<model>/vectors/layer_{f:.2f}/{Concept}.npz       (+ .json metadata)
 
 ``results.json`` existence is the sweep's resume/completion marker, so this
-layout IS the failure-recovery mechanism (SURVEY.md §5.3).
+layout IS the failure-recovery mechanism (SURVEY.md §5.3) — which is exactly
+why every artifact here goes through :func:`atomic_write`: a marker file
+must either exist complete or not at all. A process killed mid-``json.dump``
+must never leave a truncated ``results.json`` that a resumed sweep would
+read as "cell done" (or crash parsing). Sub-cell granularity is the trial
+journal's job (``runtime.journal``); this module guarantees the cell/run
+artifacts are atomic.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
 from pathlib import Path
 from typing import Mapping, Optional, Sequence
+
+
+@contextlib.contextmanager
+def atomic_write(path: Path | str, mode: str = "w", **open_kw):
+    """Write-temp + fsync + ``os.replace`` publication of one artifact.
+
+    The temp file lives next to the target (same filesystem — ``os.replace``
+    must not cross devices) under a ``.tmp`` suffix; on clean exit it is
+    fsynced and atomically renamed over the target, so readers only ever see
+    the old complete file or the new complete file. On error the temp file
+    is removed and the target is untouched.
+    """
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + ".tmp")
+    f = open(tmp, mode, **open_kw)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+    except BaseException:
+        f.close()
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
 
 
 def config_dir(
@@ -57,7 +92,7 @@ def save_evaluation_results(
         "metrics": dict(metrics or {}),
         "n_samples": len(results),
     }
-    with open(save_path, "w") as f:
+    with atomic_write(save_path) as f:
         json.dump(output, f, indent=2)
 
 
@@ -88,7 +123,7 @@ def save_run_manifest(manifest: Mapping, out_base: Path | str) -> Path:
             return list(o)
         return str(o)
 
-    with open(path, "w") as f:
+    with atomic_write(path) as f:
         json.dump(dict(manifest), f, indent=2, default=_default)
     return path
 
@@ -112,9 +147,17 @@ def results_to_csv(results: Sequence[dict], save_path: Path | str) -> None:
     save_path = Path(save_path)
     save_path.parent.mkdir(parents=True, exist_ok=True)
 
+    def _csv_safe(v):
+        # csv cannot frame NUL bytes (sampled byte-tokenizer responses can
+        # contain them); escape visibly rather than crash the artifact
+        # write. results.json keeps the exact bytes.
+        if isinstance(v, str) and "\x00" in v:
+            return v.replace("\x00", "\\x00")
+        return v
+
     rows = []
     for r in results:
-        row = {k: v for k, v in r.items() if k != "evaluations"}
+        row = {k: _csv_safe(v) for k, v in r.items() if k != "evaluations"}
         evals = r.get("evaluations")
         if evals:
             row["judge_claims_detection"] = evals.get("claims_detection", {}).get(
@@ -131,7 +174,7 @@ def results_to_csv(results: Sequence[dict], save_path: Path | str) -> None:
             if k not in fieldnames:
                 fieldnames.append(k)
 
-    with open(save_path, "w", newline="") as f:
+    with atomic_write(save_path, newline="") as f:
         writer = csv.DictWriter(f, fieldnames=fieldnames)
         writer.writeheader()
         writer.writerows(rows)

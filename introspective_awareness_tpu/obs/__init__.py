@@ -14,6 +14,10 @@ Four pieces, threaded through runner / sweep / judge / bench / scripts:
 - :mod:`~introspective_awareness_tpu.obs.pipeline` — overlap gauges for the
   software-pipelined scheduler loop: host-wait vs device-idle ms per chunk,
   in-flight depth, bubble fraction.
+- :mod:`~introspective_awareness_tpu.obs.recovery` — crash-recovery gauges
+  (recovered/replayed/requeued trials, torn records, deferred grades,
+  resume wall time) riding on the trial journal into the run ledger,
+  manifest, and bench JSON.
 - :mod:`~introspective_awareness_tpu.obs.timing` — the original wall-timer
   registry, profiler capture, and NaN/Inf sanitizers (promoted from
   ``utils/observability.py``, which still re-exports for back-compat).
@@ -28,6 +32,7 @@ from introspective_awareness_tpu.obs.ledger import (
     load_ledger,
 )
 from introspective_awareness_tpu.obs.pipeline import PipelineGauges, StagedGauges
+from introspective_awareness_tpu.obs.recovery import RecoveryGauges
 from introspective_awareness_tpu.obs.preflight import (
     HbmPreflightError,
     PreflightReport,
@@ -49,6 +54,7 @@ __all__ = [
     "NullLedger",
     "PHASES",
     "PipelineGauges",
+    "RecoveryGauges",
     "StagedGauges",
     "PreflightReport",
     "RunLedger",

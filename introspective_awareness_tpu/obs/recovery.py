@@ -1,0 +1,45 @@
+"""Recovery gauges: what crash recovery actually did, made measurable.
+
+A resumed sweep should say — in the ledger, the run manifest, and bench
+JSON — exactly how much work the trial journal saved and what is still
+owed. One :class:`RecoveryGauges` instance rides on the
+:class:`~introspective_awareness_tpu.runtime.journal.TrialJournal`: replay
+fills the replayed/recovered/torn counters, the protocol layer adds how
+many trials were re-enqueued, the grade pool adds deferred grades, and the
+sweep stamps the resume wall time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class RecoveryGauges:
+    """Counters for one journal lifetime (fresh run: everything stays 0)."""
+
+    # Journal replay (set when an existing journal is opened).
+    replayed_records: int = 0       # valid records replayed from disk
+    recovered_trials: int = 0       # decoded trials marked done without decode
+    recovered_grades: int = 0       # graded verdicts recovered with them
+    torn_records_dropped: int = 0   # invalid tail lines truncated at replay
+    # Resume execution.
+    requeued_trials: int = 0        # remainder re-enqueued into the scheduler
+    resume_wall_s: float = 0.0      # journal open + replay + compaction time
+    # Judge resilience.
+    deferred_grades: int = 0        # trials pushed to the deferred queue
+    regraded_deferred: int = 0      # deferred trials graded post-hoc on resume
+    clean_stop: bool = False        # prior run ended via graceful shutdown
+
+    def as_stats(self) -> dict:
+        return {
+            "replayed_records": int(self.replayed_records),
+            "recovered_trials": int(self.recovered_trials),
+            "recovered_grades": int(self.recovered_grades),
+            "torn_records_dropped": int(self.torn_records_dropped),
+            "requeued_trials": int(self.requeued_trials),
+            "resume_wall_s": round(float(self.resume_wall_s), 4),
+            "deferred_grades": int(self.deferred_grades),
+            "regraded_deferred": int(self.regraded_deferred),
+            "clean_stop": bool(self.clean_stop),
+        }

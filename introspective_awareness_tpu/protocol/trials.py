@@ -16,6 +16,7 @@ import numpy as np
 
 from introspective_awareness_tpu.protocol.prompts import render_trial_prompt
 from introspective_awareness_tpu.protocol.detect import check_concept_mentioned
+from introspective_awareness_tpu.runtime.journal import SweepInterrupted
 
 TRIAL_TYPES = ("injection", "control", "forced_injection")
 
@@ -36,6 +37,10 @@ def run_trial_pass(
     scheduler: str = "batch",
     staged: bool = False,
     grade_pool=None,
+    journal=None,
+    pass_key: Optional[str] = None,
+    stop_event=None,
+    faults=None,
 ) -> list[dict]:
     """One batched pass of a trial type over (concept, trial) tasks.
 
@@ -66,6 +71,8 @@ def run_trial_pass(
             max_new_tokens=max_new_tokens, temperature=temperature,
             batch_size=batch_size, seed=seed, scheduler="continuous",
             staged=staged, grade_pool=grade_pool,
+            journal=journal, pass_key=pass_key,
+            stop_event=stop_event, faults=faults,
         )
     if scheduler != "batch":
         raise ValueError(f"unknown scheduler {scheduler!r}")
@@ -132,6 +139,10 @@ def run_grid_pass(
     scheduler: str = "batch",
     staged: bool = False,
     grade_pool=None,
+    journal=None,
+    pass_key: Optional[str] = None,
+    stop_event=None,
+    faults=None,
 ) -> list[dict]:
     """One batched pass where every row may belong to a DIFFERENT
     (layer, strength) cell — the fused-sweep path.
@@ -153,11 +164,28 @@ def run_grid_pass(
     The returned list is still in task order, with ``evaluations`` attached
     wherever the pool graded in time; rows the pool missed (worker error)
     come back ungraded for the caller's post-hoc fallback.
+
+    ``journal``/``pass_key`` (a ``runtime.TrialJournal``; continuous only)
+    make the pass crash-safe at trial granularity: every finalized trial is
+    appended to the journal under ``pass_key`` before grading, and on entry
+    trials the journal already holds are *replayed* — skipped in the
+    scheduler queue, resubmitted to the grade pool only if their verdict is
+    missing. The remainder runs with its original queue indices as
+    ``trial_ids`` so the per-trial PRNG streams — and therefore sampled
+    text — are bit-identical to an uninterrupted run. ``stop_event`` turns
+    SIGTERM-style shutdown into a drained, journaled
+    :class:`~introspective_awareness_tpu.runtime.journal.SweepInterrupted`;
+    ``faults`` threads the deterministic fault plan through.
     """
     if trial_type not in TRIAL_TYPES:
         raise ValueError(f"unknown trial_type {trial_type!r} (expected {TRIAL_TYPES})")
     if scheduler not in ("batch", "continuous"):
         raise ValueError(f"unknown scheduler {scheduler!r}")
+    if journal is not None and scheduler != "continuous":
+        raise ValueError(
+            "trial journal requires scheduler='continuous' (the batch path "
+            "has no per-trial completion events to journal)"
+        )
     injected = trial_type != "control"
 
     render_cache: dict[int, tuple[str, Optional[int]]] = {}
@@ -193,39 +221,118 @@ def run_grid_pass(
                 "trial_type": trial_type,
             }
 
+        N = len(tasks)
+        ledger = getattr(runner, "ledger", None)
+
+        # Journal replay: trials a previous (crashed or stopped) run already
+        # decoded under this pass_key skip the scheduler entirely; only the
+        # remainder is enqueued, keeping its ORIGINAL queue indices as
+        # trial_ids so PRNG streams line up with the uninterrupted run.
+        recovered: dict[int, dict] = {}
+        jgraded: dict[int, dict] = {}
+        if journal is not None:
+            recovered = journal.decoded(pass_key)
+            jgraded = journal.graded(pass_key)
+        remaining = [i for i in range(N) if i not in recovered]
+        pos_of = {i: j for j, i in enumerate(remaining)}
+        if journal is not None and recovered:
+            journal.gauges.requeued_trials += len(remaining)
+            if ledger is not None:
+                ledger.event(
+                    "journal_recovery", pass_key=pass_key,
+                    recovered=len(recovered),
+                    recovered_graded=len(jgraded),
+                    requeued=len(remaining),
+                )
+
         streamed: dict[int, dict] = {}
         result_cb = None
-        if grade_pool is not None:
-            def result_cb(i: int, response: str) -> None:
+        if grade_pool is not None or journal is not None:
+            def result_cb(j: int, response: str) -> None:
+                i = remaining[j]
                 r = make_result(i, response)
                 streamed[i] = r
-                grade_pool.submit(i, r)
+                # Journal before grading: a crash between the two leaves a
+                # decoded-but-ungraded record, which resume re-grades — never
+                # a graded-but-unjournaled decode.
+                if journal is not None:
+                    journal.record_decoded(pass_key, i, r)
+                if grade_pool is not None:
+                    grade_pool.submit(i, r)
 
-        responses = runner.generate_grid_scheduled(
-            prompts,
-            layer_indices=layers,
-            steering_vectors=vecs,
-            strengths=strengths,
-            max_new_tokens=max_new_tokens,
-            temperature=temperature,
-            steering_start_positions=starts,
-            seed=seed,
-            slots=batch_size,
-            staged=staged,
-            result_cb=result_cb,
-        )
+        # Recovered trials whose verdict didn't make it into the journal are
+        # resubmitted up front, so their grading overlaps the remainder's
+        # decode just like fresh trials.
+        if grade_pool is not None:
+            for i, r in recovered.items():
+                if i not in jgraded:
+                    grade_pool.submit(i, r)
+
+        responses: list[str] = []
+        if remaining:
+            try:
+                responses = runner.generate_grid_scheduled(
+                    [prompts[i] for i in remaining],
+                    layer_indices=[layers[i] for i in remaining],
+                    steering_vectors=[vecs[i] for i in remaining],
+                    strengths=[strengths[i] for i in remaining],
+                    max_new_tokens=max_new_tokens,
+                    temperature=temperature,
+                    steering_start_positions=[starts[i] for i in remaining],
+                    seed=seed,
+                    slots=batch_size,
+                    staged=staged,
+                    result_cb=result_cb,
+                    trial_ids=remaining if journal is not None else None,
+                    stop_event=stop_event,
+                    faults=faults,
+                )
+            except SweepInterrupted:
+                # Graceful stop: everything harvested so far has already
+                # passed through result_cb (journaled + submitted). Join the
+                # grading workers and flush before handing control up.
+                if grade_pool is not None:
+                    grade_pool.finish(decode_end=time.perf_counter())
+                if journal is not None:
+                    journal.flush()
+                raise
+
         if grade_pool is None:
-            return [make_result(i, r) for i, r in enumerate(responses)]
-        # Join the grading workers and restore queue order: graded where the
-        # pool finished, the streamed (ungraded) dict where it didn't.
+            out = []
+            for i in range(N):
+                if i in recovered:
+                    r = dict(recovered[i])
+                    if i in jgraded:
+                        r["evaluations"] = jgraded[i]
+                    out.append(r)
+                elif i in streamed:
+                    out.append(streamed[i])
+                else:
+                    out.append(make_result(i, responses[pos_of[i]]))
+            return out
+        # Join the grading workers and restore queue order: pool-graded where
+        # it finished, journal-recovered (with any recovered verdict) next,
+        # the streamed (ungraded) dict where grading was deferred.
         graded, gstats = grade_pool.finish(decode_end=time.perf_counter())
-        ledger = getattr(runner, "ledger", None)
         if ledger is not None:
-            ledger.event("grading_overlap", trials=len(tasks), **gstats)
-        return [
-            graded.get(i, streamed.get(i) or make_result(i, responses[i]))
-            for i in range(len(tasks))
-        ]
+            gstats = dict(gstats)
+            for d in gstats.pop("degraded", []):
+                ledger.event("grade_degraded", pass_key=pass_key, **d)
+            ledger.event("grading_overlap", trials=N, **gstats)
+        out = []
+        for i in range(N):
+            if i in graded:
+                out.append(graded[i])
+            elif i in recovered:
+                r = dict(recovered[i])
+                if i in jgraded:
+                    r["evaluations"] = jgraded[i]
+                out.append(r)
+            elif i in streamed:
+                out.append(streamed[i])
+            else:
+                out.append(make_result(i, responses[pos_of[i]]))
+        return out
 
     results: list[dict] = []
     for start in range(0, len(tasks), batch_size):

@@ -6,5 +6,22 @@ on the traced capture/steer forward in ``models.transformer``.
 
 from introspective_awareness_tpu.runtime.generate import GenSpec, generate_tokens
 from introspective_awareness_tpu.runtime.runner import ModelRunner
+from introspective_awareness_tpu.runtime.journal import (
+    JournalConfigMismatch,
+    JournalError,
+    SweepInterrupted,
+    TrialJournal,
+)
+from introspective_awareness_tpu.runtime.faults import FaultPlan, InjectedCrash
 
-__all__ = ["GenSpec", "generate_tokens", "ModelRunner"]
+__all__ = [
+    "GenSpec",
+    "generate_tokens",
+    "ModelRunner",
+    "TrialJournal",
+    "JournalError",
+    "JournalConfigMismatch",
+    "SweepInterrupted",
+    "FaultPlan",
+    "InjectedCrash",
+]

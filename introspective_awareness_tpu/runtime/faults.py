@@ -1,0 +1,176 @@
+"""Deterministic fault injection for crash-safety tests and CI smoke lanes.
+
+Real TPU fleets preempt hosts mid-chunk, rate-limit judge APIs, and kill
+processes halfway through a journal write. This module makes every one of
+those failures reproducible on demand: a :class:`FaultPlan` names the
+injection points (by deterministic counters, never wall clock or RNG) and
+the durability stack ticks them at the exact places the real failures
+strike —
+
+- ``crash_after_chunks=k``: :class:`InjectedCrash` out of the scheduler
+  host loop after the k-th processed decode chunk (a preemption mid-sweep);
+- ``crash_on_admission=k``: crash as the k-th admission/refill dispatches
+  (kill mid-admission, the window where slot state is half-updated);
+- ``judge_timeout= / judge_rate_limit= / judge_5xx=n``: the first n
+  streaming-grade batches fail with the named error class (judge outage /
+  shared rate-limit event / server errors) before any real client call;
+- ``torn_tail``: after a crash, shear the journal's final record mid-line
+  (:meth:`tear_tail`) the way a kill mid-``write`` does.
+
+Plans parse from a spec string (``--inject-faults`` /  the ``IAT_FAULTS``
+env var): comma-separated ``key=value`` pairs, bare keys meaning 1 —
+``"crash_after_chunks=3,judge_timeout=2,torn_tail"``.
+
+:class:`InjectedCrash` deliberately subclasses :class:`BaseException`-side
+``RuntimeError`` so ordinary ``except Exception`` recovery paths in the
+sweep observe it exactly like a real error would reach them — tests catch
+it explicitly at the harness boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+ENV_VAR = "IAT_FAULTS"
+
+
+class InjectedCrash(RuntimeError):
+    """A FaultPlan injection point fired: simulate a hard host crash."""
+
+
+class InjectedJudgeTimeout(TimeoutError):
+    """Injected judge request timeout."""
+
+
+class InjectedJudgeRateLimit(RuntimeError):
+    """Injected judge rate-limit (HTTP 429) failure."""
+
+
+class InjectedJudgeServerError(RuntimeError):
+    """Injected judge server (HTTP 5xx) failure."""
+
+
+@dataclass
+class FaultPlan:
+    """Deterministic injection counters; one plan drives one sweep process.
+
+    Counters are process-lifetime and thread-safe (grade-pool workers
+    consume judge faults concurrently with the scheduler thread ticking
+    chunk counters).
+    """
+
+    crash_after_chunks: int = 0
+    crash_on_admission: int = 0
+    judge_timeout: int = 0
+    judge_rate_limit: int = 0
+    judge_5xx: int = 0
+    torn_tail: int = 0
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+    _chunks: int = field(default=0, repr=False, compare=False)
+    _admissions: int = field(default=0, repr=False, compare=False)
+    _judge_fails: int = field(default=0, repr=False, compare=False)
+
+    _KEYS = (
+        "crash_after_chunks", "crash_on_admission",
+        "judge_timeout", "judge_rate_limit", "judge_5xx", "torn_tail",
+    )
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        """``"crash_after_chunks=3,judge_timeout=2,torn_tail"`` → FaultPlan."""
+        kw: dict[str, int] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, value = part.partition("=")
+            key = key.strip().replace("-", "_")
+            if key not in cls._KEYS:
+                raise ValueError(
+                    f"unknown fault {key!r} (expected one of {cls._KEYS})"
+                )
+            kw[key] = int(value) if value else 1
+        return cls(**kw)
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        spec = os.environ.get(ENV_VAR, "").strip()
+        return cls.from_spec(spec) if spec else None
+
+    # -- scheduler injection points -----------------------------------------
+
+    def tick(self, point: str) -> None:
+        """Advance the named counter; raise :class:`InjectedCrash` when its
+        configured threshold is reached. Points: ``"chunk"`` (one processed
+        decode chunk), ``"admission"`` (one refill/admit dispatch)."""
+        with self._lock:
+            if point == "chunk":
+                self._chunks += 1
+                if self.crash_after_chunks and (
+                    self._chunks == self.crash_after_chunks
+                ):
+                    raise InjectedCrash(
+                        f"injected crash after chunk {self._chunks}"
+                    )
+            elif point == "admission":
+                self._admissions += 1
+                if self.crash_on_admission and (
+                    self._admissions == self.crash_on_admission
+                ):
+                    raise InjectedCrash(
+                        f"injected crash on admission {self._admissions}"
+                    )
+            else:
+                raise ValueError(f"unknown fault point {point!r}")
+
+    # -- judge injection points ---------------------------------------------
+
+    def judge_failure(self) -> Optional[Exception]:
+        """Consume one injected judge failure, or None once the configured
+        outage (timeouts, then rate-limits, then 5xx) is exhausted."""
+        with self._lock:
+            n = self._judge_fails
+            self._judge_fails += 1
+        if n < self.judge_timeout:
+            return InjectedJudgeTimeout("injected judge request timeout")
+        n -= self.judge_timeout
+        if n < self.judge_rate_limit:
+            return InjectedJudgeRateLimit("injected judge rate limit (429)")
+        n -= self.judge_rate_limit
+        if n < self.judge_5xx:
+            return InjectedJudgeServerError("injected judge server error (503)")
+        with self._lock:
+            self._judge_fails -= 1  # nothing consumed
+        return None
+
+    # -- journal injection point --------------------------------------------
+
+    def tear_tail(self, path: Path | str) -> int:
+        """Shear the file's final record mid-line, simulating a kill during
+        the journal append ``write``. Returns the number of bytes removed.
+        Called by the test/smoke harness AFTER it catches the injected
+        crash (the side effect a real kill would have left behind)."""
+        if not self.torn_tail:
+            return 0
+        path = Path(path)
+        raw = path.read_bytes()
+        if not raw:
+            return 0
+        body = raw[:-1] if raw.endswith(b"\n") else raw
+        last_nl = body.rfind(b"\n")
+        last_line_start = last_nl + 1
+        last_len = len(raw) - last_line_start
+        if last_len <= 1:
+            return 0
+        # Cut the final record roughly in half — enough bytes survive that
+        # the line is nonempty yet cannot CRC-validate.
+        keep = last_line_start + max(1, last_len // 2)
+        with open(path, "r+b") as f:
+            f.truncate(keep)
+        return len(raw) - keep

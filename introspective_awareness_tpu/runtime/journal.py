@@ -1,0 +1,382 @@
+"""Trial-level durability journal: the sweep's write-ahead log.
+
+The artifact contract resumes at CELL granularity — a cell is done iff its
+``results.json`` exists — but the continuous scheduler deliberately drains
+one queue spanning *all* grid cells, so a preemption mid-sweep used to
+discard every decoded-but-unpersisted trial across the whole grid.
+:class:`TrialJournal` closes that gap: an append-only, CRC-framed JSONL
+write-ahead journal recording each trial's lifecycle the moment it happens
+(``decoded`` from the scheduler's ``result_cb``, ``graded`` /
+``grade_deferred`` from the streaming grade pool's completion path). On
+restart the journal is replayed, recovered trials are marked done, and only
+the remainder re-enters the scheduler — with their ORIGINAL queue indices,
+so the per-trial queue-indexed PRNG streams (and therefore greedy AND
+sampled outputs) are bit-identical to an uninterrupted run.
+
+Framing: each line is ``<crc32 hex8> <compact-json>\\n``. The CRC covers
+the JSON bytes, so a record either replays verbatim or is detectably
+corrupt. Recovery is torn-tail-tolerant: a kill mid-``write`` leaves at
+most a partial final line, which replay drops (and counts) before
+truncating the file back to its valid prefix; corruption *before* the last
+record means the file was damaged by something other than a torn write and
+raises :class:`JournalError` rather than silently losing state. Duplicate
+records replay last-write-wins. The first record is a config signature —
+replaying a journal against a different grid (model, concepts, sweep axes,
+seed, ...) raises :class:`JournalConfigMismatch` naming the differing keys
+instead of resuming into silently-wrong artifacts.
+
+Durability knobs: every record is flushed to the OS on append;
+``fsync_every`` batches the (expensive) fsync so the decode hot path is not
+gated on disk latency — a crash between fsyncs loses at most that many
+trail records, which simply re-decode on resume. ``compact()`` atomically
+rotates the journal (write temp + fsync + ``os.replace``) down to its live
+state, dropping superseded duplicates.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from pathlib import Path
+from typing import Optional
+
+from introspective_awareness_tpu.obs.recovery import RecoveryGauges
+
+
+class JournalError(RuntimeError):
+    """Journal corruption that torn-tail recovery cannot explain."""
+
+
+class JournalConfigMismatch(JournalError):
+    """Journal was written by a sweep with a different grid configuration."""
+
+
+class SweepInterrupted(RuntimeError):
+    """Graceful shutdown: the scheduler drained in-flight work and stopped.
+
+    Raised by the runner when ``run_scheduled`` returns with
+    ``stats["interrupted"]`` after a stop event (SIGTERM/SIGINT). Everything
+    finalized before the stop was already surfaced through ``result_cb``
+    (and journaled, when a journal is attached); unfinalized trials simply
+    re-decode on resume.
+    """
+
+
+def _frame(obj: dict) -> bytes:
+    line = json.dumps(obj, separators=(",", ":"), sort_keys=True)
+    data = line.encode("utf-8")
+    return b"%08x " % zlib.crc32(data) + data + b"\n"
+
+
+def _parse_line(raw: bytes) -> Optional[dict]:
+    """One framed record, or None if the line fails CRC/JSON validation."""
+    if len(raw) < 10 or raw[8:9] != b" ":
+        return None
+    try:
+        crc = int(raw[:8], 16)
+    except ValueError:
+        return None
+    data = raw[9:].rstrip(b"\n")
+    if zlib.crc32(data) != crc:
+        return None
+    try:
+        obj = json.loads(data)
+    except ValueError:
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+class TrialJournal:
+    """Append-only trial-lifecycle WAL with torn-tail-tolerant replay.
+
+    Thread-safe: ``record_graded`` / ``record_deferred`` are called from
+    streaming-grade-pool worker threads while the scheduler thread appends
+    ``decoded`` records.
+    """
+
+    SCHEMA = 1
+
+    def __init__(
+        self,
+        path: Path | str,
+        config: dict,
+        fsync_every: int = 16,
+    ):
+        self.path = Path(path)
+        self.config = json.loads(json.dumps(config))  # JSON-normalized
+        self.fsync_every = max(1, int(fsync_every))
+        self.gauges = RecoveryGauges()
+        self._lock = threading.Lock()
+        self._unsynced = 0
+        # Replayed state: pass_key -> {trial queue index -> payload}.
+        self._decoded: dict[str, dict[int, dict]] = {}
+        self._graded: dict[str, dict[int, dict]] = {}
+        self._deferred: dict[str, dict[int, dict]] = {}
+        self._regraded_cells: set[tuple[float, float]] = set()
+        self.was_clean_stop = False
+        self.resumed = False
+
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        existing = self.path.exists() and self.path.stat().st_size > 0
+        if existing:
+            self._replay()
+            self._f = open(self.path, "r+b")
+            self._f.truncate(self._valid_bytes)
+            self._f.seek(0, os.SEEK_END)
+            if self._valid_bytes == 0:
+                # Nothing valid survived (torn first write): start fresh —
+                # the config signature must still lead the file.
+                self._append({"ev": "start", "schema": self.SCHEMA,
+                              "config": self.config})
+                self.flush()
+            else:
+                self.resumed = True
+        else:
+            self._f = open(self.path, "wb")
+            self._append({"ev": "start", "schema": self.SCHEMA,
+                          "config": self.config})
+            self.flush()
+
+    # -- replay --------------------------------------------------------------
+
+    def _replay(self) -> None:
+        raw = self.path.read_bytes()
+        lines = raw.splitlines(keepends=True)
+        records: list[dict] = []
+        offsets: list[int] = []  # byte offset of each line's END
+        off = 0
+        bad_at: Optional[int] = None
+        for i, ln in enumerate(lines):
+            off += len(ln)
+            rec = _parse_line(ln)
+            if rec is None:
+                if bad_at is None:
+                    bad_at = i
+                continue
+            if bad_at is not None:
+                # A valid record AFTER an invalid one: this is mid-file
+                # corruption, not a torn final write — refuse to guess.
+                raise JournalError(
+                    f"{self.path}: corrupt record at line {bad_at + 1} "
+                    f"followed by valid records — the journal is damaged "
+                    f"beyond torn-tail recovery (line {i + 1} still parses). "
+                    f"Move the file aside or rerun with --overwrite."
+                )
+            records.append(rec)
+            offsets.append(off)
+        if bad_at is not None:
+            self.gauges.torn_records_dropped += len(lines) - bad_at
+        self._valid_bytes = offsets[-1] if offsets else 0
+
+        if not records:
+            # File existed but held nothing valid (e.g. torn first write):
+            # treat as fresh.
+            self._valid_bytes = 0
+            self.gauges.replayed_records = 0
+            return
+        head = records[0]
+        if head.get("ev") != "start":
+            raise JournalError(
+                f"{self.path}: first record is {head.get('ev')!r}, not the "
+                f"'start' config signature — not a trial journal"
+            )
+        if head.get("config") != self.config:
+            theirs = head.get("config") or {}
+            diff = sorted(
+                k for k in set(theirs) | set(self.config)
+                if theirs.get(k) != self.config.get(k)
+            )
+            raise JournalConfigMismatch(
+                f"{self.path} was written by a sweep with a different "
+                f"configuration (differing keys: {diff}). Resuming it would "
+                f"produce artifacts from a mixed grid. Pass --overwrite to "
+                f"discard the journal, or point --output-dir elsewhere."
+            )
+        for rec in records[1:]:
+            self._apply(rec)
+        self.gauges.replayed_records = len(records) - 1
+        self.gauges.recovered_trials = sum(
+            len(m) for m in self._decoded.values()
+        )
+        self.gauges.recovered_grades = sum(
+            len(m) for m in self._graded.values()
+        )
+        self.was_clean_stop = self._saw_clean_stop
+
+    _saw_clean_stop = False
+
+    def _apply(self, rec: dict) -> None:
+        ev = rec.get("ev")
+        if ev == "decoded":
+            self._decoded.setdefault(rec["pass"], {})[int(rec["idx"])] = (
+                rec["result"]
+            )
+        elif ev == "graded":
+            self._graded.setdefault(rec["pass"], {})[int(rec["idx"])] = (
+                rec["evaluations"]
+            )
+        elif ev == "grade_deferred":
+            self._deferred.setdefault(rec["pass"], {})[int(rec["idx"])] = rec
+        elif ev == "cell_regraded":
+            self._regraded_cells.add(tuple(rec["cell"]))
+        elif ev == "clean_stop":
+            self._saw_clean_stop = True
+        # Unknown events are skipped: a newer writer's records must not
+        # brick an older reader (schema gate lives in the start record).
+
+    # -- append --------------------------------------------------------------
+
+    def _append(self, obj: dict) -> None:
+        self._f.write(_frame(obj))
+        self._f.flush()
+        self._unsynced += 1
+        if self._unsynced >= self.fsync_every:
+            os.fsync(self._f.fileno())
+            self._unsynced = 0
+
+    def record_decoded(self, pass_key: str, idx: int, result: dict) -> None:
+        """One trial finalized by the scheduler (from ``result_cb``)."""
+        with self._lock:
+            self._append({"ev": "decoded", "pass": pass_key, "idx": int(idx),
+                          "result": result})
+            self._decoded.setdefault(pass_key, {})[int(idx)] = result
+
+    def record_graded(
+        self, pass_key: str, idx: int, evaluations: dict
+    ) -> None:
+        """One trial graded (streaming pool worker or post-hoc path)."""
+        with self._lock:
+            self._append({"ev": "graded", "pass": pass_key, "idx": int(idx),
+                          "evaluations": evaluations})
+            self._graded.setdefault(pass_key, {})[int(idx)] = evaluations
+            self._deferred.get(pass_key, {}).pop(int(idx), None)
+
+    def record_deferred(
+        self,
+        pass_key: str,
+        idx: int,
+        error: str,
+        attempts: int,
+        cell: Optional[tuple[float, float]] = None,
+    ) -> None:
+        """Grading gave up on a trial (circuit open / retries exhausted);
+        queue it for post-hoc grading on resume."""
+        rec = {"ev": "grade_deferred", "pass": pass_key, "idx": int(idx),
+               "error": error, "attempts": int(attempts),
+               "cell": None if cell is None else list(cell)}
+        with self._lock:
+            self._append(rec)
+            self._deferred.setdefault(pass_key, {})[int(idx)] = rec
+            self.gauges.deferred_grades += 1
+
+    def record_cell_regraded(self, cell: tuple[float, float]) -> None:
+        """A deferred cell's rows were graded post-hoc; its deferral is
+        resolved."""
+        with self._lock:
+            self._append({"ev": "cell_regraded", "cell": list(cell)})
+            self._regraded_cells.add(tuple(cell))
+
+    def record_clean_stop(self) -> None:
+        """Graceful-shutdown marker: in-flight chunks drained, journal
+        flushed — resume can trust there was no torn write."""
+        with self._lock:
+            self._append({"ev": "clean_stop"})
+            self._sync_locked()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._sync_locked()
+
+    def _sync_locked(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._unsynced = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._f.close()
+
+    # -- replayed-state accessors -------------------------------------------
+
+    def decoded(self, pass_key: str) -> dict[int, dict]:
+        """queue index -> decoded result dict, for one pass."""
+        return dict(self._decoded.get(pass_key, {}))
+
+    def graded(self, pass_key: str) -> dict[int, dict]:
+        """queue index -> evaluations dict, for one pass."""
+        return dict(self._graded.get(pass_key, {}))
+
+    def deferred(self, pass_key: str) -> dict[int, dict]:
+        """Deferred-and-not-since-graded trials for one pass."""
+        out = {}
+        for idx, rec in self._deferred.get(pass_key, {}).items():
+            if idx not in self._graded.get(pass_key, {}):
+                out[idx] = rec
+        return out
+
+    def deferred_cells(self) -> set[tuple[float, float]]:
+        """(layer_fraction, strength) cells with unresolved deferred grades."""
+        cells: set[tuple[float, float]] = set()
+        for pass_key, recs in self._deferred.items():
+            for idx, rec in recs.items():
+                if idx in self._graded.get(pass_key, {}):
+                    continue
+                if rec.get("cell"):
+                    cells.add(tuple(rec["cell"]))
+        return cells - self._regraded_cells
+
+    def has_state(self) -> bool:
+        return bool(self._decoded or self._graded or self._deferred)
+
+    # -- rotation ------------------------------------------------------------
+
+    def compact(self) -> None:
+        """Atomically rewrite the journal down to its live state: one record
+        per (pass, trial), superseded duplicates and resolved deferrals
+        dropped. Crash-safe: temp file + fsync + ``os.replace``."""
+        with self._lock:
+            tmp = self.path.with_name(self.path.name + ".tmp")
+            with open(tmp, "wb") as f:
+                f.write(_frame({"ev": "start", "schema": self.SCHEMA,
+                                "config": self.config}))
+                for pass_key in sorted(self._decoded):
+                    for idx in sorted(self._decoded[pass_key]):
+                        f.write(_frame({
+                            "ev": "decoded", "pass": pass_key, "idx": idx,
+                            "result": self._decoded[pass_key][idx],
+                        }))
+                for pass_key in sorted(self._graded):
+                    for idx in sorted(self._graded[pass_key]):
+                        f.write(_frame({
+                            "ev": "graded", "pass": pass_key, "idx": idx,
+                            "evaluations": self._graded[pass_key][idx],
+                        }))
+                for pass_key in sorted(self._deferred):
+                    for idx in sorted(self._deferred[pass_key]):
+                        if idx in self._graded.get(pass_key, {}):
+                            continue
+                        rec = self._deferred[pass_key][idx]
+                        cell = rec.get("cell")
+                        if cell and tuple(cell) in self._regraded_cells:
+                            continue
+                        f.write(_frame(rec))
+                f.flush()
+                os.fsync(f.fileno())
+            self._f.close()
+            os.replace(tmp, self.path)
+            self._f = open(self.path, "ab")
+            self._unsynced = 0
+
+    def discard(self) -> None:
+        """The sweep completed with everything persisted in final artifacts:
+        the journal has nothing left to recover."""
+        self.close()
+        try:
+            self.path.unlink()
+        except FileNotFoundError:
+            pass

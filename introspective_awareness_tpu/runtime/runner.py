@@ -36,6 +36,7 @@ from introspective_awareness_tpu.runtime.generate import (
     generate_tokens,
     generate_tokens_prefix,
 )
+from introspective_awareness_tpu.runtime.journal import SweepInterrupted
 from introspective_awareness_tpu.runtime.scheduler import (
     TrialRequest,
     run_scheduled,
@@ -603,6 +604,9 @@ class ModelRunner:
         lookahead: int = 2,
         suffix_bucket: int = 16,
         result_cb: Optional[Callable[[int, str], None]] = None,
+        trial_ids: Optional[Sequence[int]] = None,
+        stop_event=None,
+        faults=None,
         **kw,
     ) -> list[str]:
         """Continuous-batching counterpart of
@@ -621,6 +625,18 @@ class ModelRunner:
         decoded_text)`` the moment each trial finishes — while decode
         continues — so the caller can stream finished trials into judge
         grading; the final return value is still the full in-order list.
+
+        Durability hooks (runtime.journal / runtime.faults): ``trial_ids``
+        names each queue row's PRNG stream explicitly — a resumed sweep
+        passes the *original* queue indices of the remaining trials so
+        their sampled text is bit-identical to the uninterrupted run
+        regardless of how many trials were already recovered. A set
+        ``stop_event`` drains in-flight chunks and raises
+        :class:`SweepInterrupted` (partial work reaches ``result_cb``
+        first, so the caller's journal is complete up to the stop).
+        ``faults`` is a deterministic
+        :class:`~introspective_awareness_tpu.runtime.faults.FaultPlan`
+        whose crash points fire between harvested chunks.
 
         Eligibility mirrors the shared-prefix path — every prompt must
         share a prefix no steered row steers inside (the sweep's preamble),
@@ -687,6 +703,10 @@ class ModelRunner:
             for b in sorted(set(budget_list)):
                 idx = [i for i in range(N) if budget_list[i] == b]
                 for c in range(0, len(idx), slots):
+                    if stop_event is not None and stop_event.is_set():
+                        raise SweepInterrupted(
+                            "stop requested during fixed-batch fallback"
+                        )
                     chunk = idx[c : c + slots]
                     batch = self.generate_batch_with_grid_steering(
                         [prompts[i] for i in chunk],
@@ -708,6 +728,10 @@ class ModelRunner:
                             # Stream at batch granularity (the finest this
                             # path has).
                             result_cb(i, batch[j])
+                    if faults is not None:
+                        # One batch call is this path's "chunk"; tick after
+                        # harvest so the journal reflects pre-crash state.
+                        faults.tick("chunk")
             return out
 
         suffix_rows = [r[L0:] for r in rows]
@@ -765,10 +789,16 @@ class ModelRunner:
                 ledger=self.ledger,
                 pipeline=pipeline, staged=staged, lookahead=lookahead,
                 suffix_bucket=suffix_bucket, result_cb=tok_cb,
+                trial_ids=trial_ids, stop_event=stop_event, faults=faults,
             )
-            span.add_evals(N)
-            span.add_tokens(int(sum(len(r) for r in results)))
+            done = [r for r in results if r is not None]
+            span.add_evals(len(done))
+            span.add_tokens(int(sum(len(r) for r in done)))
             span.set(**stats)
+            if stats.get("interrupted"):
+                raise SweepInterrupted(
+                    f"stop requested; {len(done)}/{N} trials decoded"
+                )
         return [
             texts[i] if i in texts else self._decode_row(results[i])
             for i in range(N)

@@ -168,6 +168,9 @@ def run_scheduled(
     lookahead: int = 2,
     suffix_bucket: int = 16,
     result_cb: Optional[Callable[[int, np.ndarray], None]] = None,
+    trial_ids: Optional[Sequence[int]] = None,
+    stop_event=None,
+    faults=None,
 ) -> tuple[list[np.ndarray], dict]:
     """Drain ``trials`` through ``slots`` decode rows; returns per-trial
     token arrays (input order, length = tokens actually emitted, final
@@ -192,6 +195,20 @@ def run_scheduled(
     (floored at one full batch). Greedy outputs are bit-identical to
     ``staged=False``; ``suffix_bucket <= 0`` disables width bucketing
     (every stage pads to the queue-wide ``Ss``).
+
+    ``trial_ids`` names each trial's PRNG stream index (default: its queue
+    position). A resumed sweep passes the ORIGINAL queue indices of the
+    remaining trials, so each one folds the same ``fold_in(base_key, id)``
+    stream it would have drawn uninterrupted — the property that makes a
+    journal-recovered subset run bit-identical at temperature > 0.
+
+    ``stop_event`` (a ``threading.Event``) requests graceful shutdown: the
+    loop stops dispatching, drains every in-flight chunk (finalized trials
+    still surface through ``result_cb``), and returns partial results —
+    unfinished trials come back ``None`` and ``stats["interrupted"]`` is
+    True. ``faults`` (a ``runtime.faults.FaultPlan``) ticks deterministic
+    crash-injection counters after each processed chunk and at each
+    admission dispatch.
     """
     ledger = ledger if ledger is not None else NullLedger()
     B = slots
@@ -199,9 +216,11 @@ def run_scheduled(
     if N == 0:
         return [], {"chunks": 0, "refills": 0, "mean_slot_occupancy": 0.0,
                     "padded_row_waste_steps": 0, "pipelined": bool(pipeline),
-                    "staged": bool(staged),
+                    "staged": bool(staged), "interrupted": False,
                     **PipelineGauges().as_stats(0.0, 0),
                     **StagedGauges().as_stats()}
+    if trial_ids is not None and len(trial_ids) != N:
+        raise ValueError("trial_ids must align with trials")
     Ss = int(trials[0].suffix_ids.shape[0])
     H = int(trials[0].steer_vector.shape[0])
     for t in trials:
@@ -237,11 +256,16 @@ def run_scheduled(
         stop_seqs=stop,
     )
     base_key = jax.random.key(seed)
-    # Per-trial PRNG streams: a trial's samples depend on its queue index
-    # only, never on which slot it lands in or who its neighbours are.
+    # Per-trial PRNG streams: a trial's samples depend on its stream id only
+    # (queue index, or the caller-supplied original index on a resumed
+    # subset), never on which slot it lands in or who its neighbours are.
+    stream_ids = (
+        jnp.arange(N) if trial_ids is None
+        else jnp.asarray(np.asarray(list(trial_ids), np.int64))
+    )
     trial_keydata = np.asarray(
         jax.vmap(lambda i: jax.random.key_data(jax.random.fold_in(base_key, i)))(
-            jnp.arange(N)
+            stream_ids
         ),
         np.uint32,
     )
@@ -305,6 +329,8 @@ def run_scheduled(
 
     def _dispatch_refill() -> None:
         nonlocal cache, state, next_trial, refills
+        if faults is not None:
+            faults.tick("admission")
         free = np.flatnonzero(slot_trial < 0)
         take = min(len(free), N - next_trial)
         sel = free[:take]
@@ -410,6 +436,8 @@ def run_scheduled(
         _process_one). Row→slot assignment walks ascending free slots,
         exactly the sync refill's `free[:take]` mapping."""
         nonlocal cache, state, next_trial
+        if faults is not None:
+            faults.tick("admission")
         free = np.flatnonzero(slot_trial < 0)
         fi = 0
         while fi < len(free) and stage_pool:
@@ -506,8 +534,24 @@ def run_scheduled(
         last_done = done
         if not pending:
             gauges.idle_start()
+        if faults is not None and ev.kind == "chunk":
+            # Tick AFTER harvest: trials finalized by this chunk have already
+            # surfaced through result_cb (and into the journal) — exactly the
+            # state a preemption after chunk k leaves behind.
+            faults.tick("chunk")
 
+    interrupted = False
     while True:
+        if stop_event is not None and stop_event.is_set():
+            # Graceful shutdown: dispatch nothing further, drain every
+            # in-flight op (their finalized trials still stream out through
+            # result_cb), and hand back partial results. Unfinished trials
+            # stay None — they re-decode from scratch on resume, on the
+            # same queue-indexed PRNG streams, so nothing torn leaks out.
+            while pending:
+                _process_one()
+            interrupted = True
+            break
         # Land results until at most `depth` dispatches remain in flight:
         # depth 0 reproduces the synchronous loop's decision sequence (and
         # therefore its stats) exactly; depth 1 keeps one op outstanding.
@@ -554,7 +598,8 @@ def run_scheduled(
             continue
         _dispatch_chunk()
 
-    assert all(r is not None for r in results)
+    if not interrupted:
+        assert all(r is not None for r in results)
     wall_s = time.perf_counter() - t_loop0
     stats = {
         "chunks": g,
@@ -565,6 +610,7 @@ def run_scheduled(
         "padded_row_waste_steps": int(waste_steps),
         "pipelined": bool(pipeline),
         "staged": bool(staged),
+        "interrupted": bool(interrupted),
         **gauges.as_stats(wall_s, chunks_done),
         **sgauges.as_stats(),
     }

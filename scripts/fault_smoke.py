@@ -1,0 +1,177 @@
+"""Fault-injection smoke: kill a sweep mid-decode AND take the judge down,
+resume both, and assert the final artifacts are bit-identical / complete.
+
+This is the CI lane for the crash-safety contract (README "Fault
+tolerance"), runnable anywhere the tier-1 suite runs (CPU, tiny random-init
+model):
+
+    JAX_PLATFORMS=cpu python scripts/fault_smoke.py [--temperature 1.0]
+
+Phase 1 — preemption: a reference sweep runs uninterrupted; a second sweep
+is killed by an injected crash after 2 decode chunks, its journal tail is
+sheared mid-record (what a kill during ``write`` leaves), and the rerun
+must produce every cell's results.json — responses AND metrics —
+byte-identical to the reference, recovering >0 trials from the journal.
+Default temperature is 1.0: sampled decoding is the strong form of the
+bit-identity claim (queue-indexed PRNG streams).
+
+Phase 2 — judge outage: the same sweep with a judge that fails every call
+must still exit 0 (decode-complete, keyword metrics, grading deferred to
+the kept journal); a rerun with a healthy judge grades the deferred trials
+text-only — no model load — and discards the journal.
+
+Exit code 0 = both phases hold. Any assertion prints what diverged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _argv(out_dir: Path, temperature: float) -> list[str]:
+    return [
+        "--models", "tiny",
+        "--concepts", "Dust", "Trees",
+        "--n-baseline", "5",
+        "--layer-sweep", "0.25", "0.75",
+        "--strength-sweep", "2.0", "8.0",
+        "--n-trials", "4",
+        "--max-tokens", "8",
+        "--batch-size", "16",
+        "--temperature", str(temperature),
+        "--output-dir", str(out_dir),
+        "--dtype", "float32",
+        "--judge-backend", "none",
+        "--scheduler", "continuous",
+        "--obs-ledger", "off",
+    ]
+
+
+def _cells(out_dir: Path) -> dict:
+    return {
+        p.parent.name: json.loads(p.read_text())
+        for p in sorted((out_dir / "tiny").glob("layer_*/results.json"))
+    }
+
+
+def phase_preemption(base: Path, temperature: float) -> dict:
+    from introspective_awareness_tpu.cli.sweep import main
+    from introspective_awareness_tpu.runtime.faults import FaultPlan, InjectedCrash
+
+    print(f"[phase 1] preemption + torn tail (temperature {temperature})")
+    assert main(_argv(base / "ref", temperature)) == 0
+    ref = _cells(base / "ref")
+    assert ref, "reference sweep produced no cells"
+
+    crash_argv = _argv(base / "crash", temperature)
+    try:
+        main(crash_argv + ["--inject-faults", "crash_after_chunks=2"])
+        raise AssertionError("injected crash never fired")
+    except InjectedCrash:
+        pass
+    jpath = base / "crash" / "tiny" / "trial_journal.jsonl"
+    assert jpath.exists(), "crashed sweep left no journal"
+    torn = FaultPlan(torn_tail=1).tear_tail(jpath)
+    assert torn > 0, "tear_tail removed nothing"
+
+    assert main(crash_argv) == 0, "resume run failed"
+    resumed = _cells(base / "crash")
+    for cell, data in ref.items():
+        if resumed.get(cell) != data:
+            raise AssertionError(f"cell {cell} diverged after resume")
+    assert not jpath.exists(), "journal not discarded after complete resume"
+
+    man = json.loads((base / "crash" / "tiny" / "run_manifest.json").read_text())
+    rec = man["timings"]["recovery"]
+    assert rec["recovered_trials"] > 0, f"nothing recovered: {rec}"
+    assert rec["torn_records_dropped"] >= 1, f"torn tail not dropped: {rec}"
+    print(f"[phase 1] OK: {len(ref)} cells identical, "
+          f"{rec['recovered_trials']} trials recovered, "
+          f"{rec['torn_records_dropped']} torn records dropped")
+    return rec
+
+
+def phase_judge_outage(base: Path, temperature: float) -> dict:
+    import introspective_awareness_tpu.cli.sweep as sweep_mod
+    from introspective_awareness_tpu.judge.judge import LLMJudge
+
+    class DownClient:
+        model_name = "down"
+
+        def grade(self, prompts):
+            raise RuntimeError("injected judge outage")
+
+    class YesClient:
+        model_name = "yes"
+
+        def grade(self, prompts):
+            return ["Answer: YES"] * len(prompts)
+
+    print("[phase 2] judge outage -> deferred grading -> post-hoc regrade")
+    argv = _argv(base / "outage", temperature) + ["--judge-backend", "openai"]
+    orig_build, orig_load = sweep_mod._build_judge, sweep_mod.load_subject
+    try:
+        sweep_mod._build_judge = (
+            lambda args, mesh, rules: LLMJudge(client=DownClient())
+        )
+        assert sweep_mod.main(argv) == 0, "outage sweep did not finish decode"
+        jpath = base / "outage" / "tiny" / "trial_journal.jsonl"
+        assert jpath.exists(), "journal discarded despite deferred grading"
+        for cell, data in _cells(base / "outage").items():
+            assert data["metrics"]["metrics_source"] == "keyword", cell
+            assert data["results"], f"cell {cell} lost its responses"
+
+        sweep_mod._build_judge = (
+            lambda args, mesh, rules: LLMJudge(client=YesClient())
+        )
+
+        def no_load(*a, **k):
+            raise AssertionError("re-grading must not load the subject model")
+
+        sweep_mod.load_subject = no_load
+        assert sweep_mod.main(argv) == 0, "regrade run failed"
+        assert not jpath.exists(), "journal kept after grading resolved"
+        graded = _cells(base / "outage")
+        for cell, data in graded.items():
+            assert data["metrics"]["metrics_source"] == "judge", cell
+            assert all("evaluations" in r for r in data["results"]), cell
+    finally:
+        sweep_mod._build_judge = orig_build
+        sweep_mod.load_subject = orig_load
+    print(f"[phase 2] OK: {len(graded)} cells graded post-hoc, journal discarded")
+    return {"cells_regraded": len(graded)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--workdir", type=str, default=None,
+                    help="Keep artifacts here instead of a temp dir")
+    args = ap.parse_args(argv)
+
+    def run(base: Path) -> None:
+        rec = phase_preemption(base, args.temperature)
+        out = phase_judge_outage(base, args.temperature)
+        print(json.dumps({
+            "fault_smoke": "ok",
+            "temperature": args.temperature,
+            "recovery": rec,
+            **out,
+        }))
+
+    if args.workdir:
+        run(Path(args.workdir))
+    else:
+        with tempfile.TemporaryDirectory() as td:
+            run(Path(td))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,406 @@
+"""Crash-safety integration: kill-and-resume, graceful stop, judge outage.
+
+The durability contract under test (README "Fault tolerance"):
+
+- a sweep killed mid-decode resumes from the trial journal with final
+  artifacts BIT-IDENTICAL to an uninterrupted run — greedy and sampled;
+- SIGTERM-style stops drain in flight, journal a clean-stop marker, and
+  exit 130;
+- a judge outage defers grading to the journal (circuit breaker stops the
+  retry burn), the sweep finishes decode-complete, and a later run grades
+  the deferred trials text-only without a model load.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.cli.sweep import main
+from introspective_awareness_tpu.judge import CircuitBreaker, StreamingGradePool
+from introspective_awareness_tpu.judge.judge import LLMJudge
+from introspective_awareness_tpu.runtime.faults import FaultPlan, InjectedCrash
+from introspective_awareness_tpu.runtime.journal import (
+    SweepInterrupted,
+    TrialJournal,
+)
+
+
+def _argv(tmp_path, extra=()):
+    return [
+        "--models", "tiny",
+        "--concepts", "Dust", "Trees",
+        "--n-baseline", "5",
+        "--layer-sweep", "0.25", "0.75",
+        "--strength-sweep", "2.0", "8.0",
+        "--n-trials", "4",
+        "--max-tokens", "8",
+        "--batch-size", "16",
+        "--temperature", "0.0",
+        "--output-dir", str(tmp_path / "out"),
+        "--dtype", "float32",
+        "--judge-backend", "none",
+        "--scheduler", "continuous",
+        "--obs-ledger", "off",
+        *extra,
+    ]
+
+
+CELLS = [
+    "layer_0.25_strength_2.0", "layer_0.25_strength_8.0",
+    "layer_0.75_strength_2.0", "layer_0.75_strength_8.0",
+]
+
+
+def _cell_data(out_dir):
+    return {
+        cell: json.loads((out_dir / "tiny" / cell / "results.json").read_text())
+        for cell in CELLS
+    }
+
+
+# --- kill-and-resume through the real CLI -----------------------------------
+
+
+@pytest.mark.parametrize("temperature", ["0.0", "1.0"])
+def test_kill_and_resume_bit_identical(tmp_path, temperature):
+    """Crash after 2 decode chunks + a torn journal tail, then resume: every
+    cell's results AND metrics match the uninterrupted reference exactly —
+    at temperature 0 (greedy) and 1 (sampled, via queue-indexed PRNG
+    streams) — with >0 trials recovered from the journal."""
+    temp = ["--temperature", temperature]
+
+    assert main(_argv(tmp_path / "ref", extra=temp)) == 0
+    ref = _cell_data(tmp_path / "ref" / "out")
+    # A completed sweep owes nothing: its journal is discarded.
+    assert not (tmp_path / "ref" / "out" / "tiny" / "trial_journal.jsonl").exists()
+
+    argv = _argv(tmp_path / "crash", extra=temp)
+    with pytest.raises(InjectedCrash):
+        main(argv + ["--inject-faults", "crash_after_chunks=2"])
+    jpath = tmp_path / "crash" / "out" / "tiny" / "trial_journal.jsonl"
+    assert jpath.exists()
+    # The kill also sheared the final journal record mid-write.
+    assert FaultPlan(torn_tail=1).tear_tail(jpath) > 0
+
+    assert main(argv) == 0
+    assert _cell_data(tmp_path / "crash" / "out") == ref
+    assert not jpath.exists()
+
+    man = json.loads(
+        (tmp_path / "crash" / "out" / "tiny" / "run_manifest.json").read_text()
+    )
+    rec = man["timings"]["recovery"]
+    assert rec["recovered_trials"] > 0
+    assert rec["torn_records_dropped"] >= 1
+    assert rec["deferred_grades"] == 0
+
+
+def test_journal_config_mismatch_exit_code(tmp_path, capsys):
+    out_dir = tmp_path / "out" / "tiny"
+    j = TrialJournal(out_dir / "trial_journal.jsonl", {"model": "other"})
+    j.record_decoded("p", 0, {"response": "x"})
+    j.close()
+    assert main(_argv(tmp_path)) == 2
+    out = capsys.readouterr().out
+    assert "error:" in out and "different" in out
+
+
+def test_interrupted_sweep_exits_130_with_clean_stop(tmp_path, monkeypatch, capsys):
+    """The SweepInterrupted path through main: exit code 130, resume hint,
+    and a fsynced clean-stop marker in the kept journal."""
+    import introspective_awareness_tpu.cli.sweep as sweep_mod
+
+    def fake_run_sweep(args, runner, judge, model_name):
+        args._journal.record_decoded(
+            "fused/injection", 0, {"response": "partial"}
+        )
+        raise SweepInterrupted("stop requested; 1/24 trials decoded")
+
+    monkeypatch.setattr(sweep_mod, "run_sweep", fake_run_sweep)
+    assert main(_argv(tmp_path)) == 130
+    out = capsys.readouterr().out
+    assert "rerun the same command to resume" in out
+
+    jpath = tmp_path / "out" / "tiny" / "trial_journal.jsonl"
+    raw = jpath.read_bytes()
+    assert b'"ev":"clean_stop"' in raw and b'"ev":"decoded"' in raw
+
+
+# --- graceful stop + resume at the protocol layer ---------------------------
+
+
+@pytest.fixture(scope="module")
+def runner():
+    import jax
+
+    from introspective_awareness_tpu.models.config import tiny_config
+    from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+    from introspective_awareness_tpu.models.transformer import init_params
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    cfg = tiny_config(n_layers=3)
+    params = init_params(cfg, jax.random.key(3))
+    return ModelRunner(params, cfg, ByteTokenizer(), model_name="tiny")
+
+
+def test_graceful_stop_drains_then_resume_matches(tmp_path, runner):
+    """stop_event mid-pass: SweepInterrupted after draining in-flight work,
+    partial progress journaled; a fresh journal on the same path resumes
+    the remainder and the merged pass equals the uninterrupted reference
+    (sampled decoding — the PRNG-stream-identity property)."""
+    from introspective_awareness_tpu.protocol.trials import run_grid_pass
+
+    rng = np.random.default_rng(0)
+    vec = {c: rng.normal(size=runner.cfg.hidden_size).astype(np.float32)
+           for c in ("Dust", "Trees")}
+    tasks = [("Dust" if t % 2 else "Trees", t, 0.5, 1, 4.0)
+             for t in range(1, 7)]
+    kw = dict(
+        max_new_tokens=6, temperature=1.0, batch_size=2, seed=11,
+        scheduler="continuous",
+    )
+
+    ref = run_grid_pass(
+        runner, "injection", tasks, lambda lf, c: vec[c], **kw
+    )
+    assert len(ref) == 6
+
+    cfg_sig = {"grid": "graceful-stop-test"}
+    jpath = tmp_path / "trial_journal.jsonl"
+    journal = TrialJournal(jpath, cfg_sig)
+    stop_event = threading.Event()
+    orig = journal.record_decoded
+
+    def stop_after_first(pass_key, idx, result):
+        orig(pass_key, idx, result)
+        stop_event.set()
+
+    journal.record_decoded = stop_after_first
+    with pytest.raises(SweepInterrupted):
+        run_grid_pass(
+            runner, "injection", tasks, lambda lf, c: vec[c],
+            journal=journal, pass_key="p", stop_event=stop_event, **kw
+        )
+    n_done = len(journal.decoded("p"))
+    # 2 slots, 6 trials: the drain finalizes in-flight rows only.
+    assert 0 < n_done < 6
+    journal.close()
+
+    resumed = TrialJournal(jpath, cfg_sig)
+    assert resumed.resumed
+    assert resumed.gauges.recovered_trials == n_done
+    out = run_grid_pass(
+        runner, "injection", tasks, lambda lf, c: vec[c],
+        journal=resumed, pass_key="p", **kw
+    )
+    assert out == ref
+    assert resumed.gauges.requeued_trials == 6 - n_done
+    resumed.discard()
+
+
+def test_journal_requires_continuous_scheduler(tmp_path, runner):
+    from introspective_awareness_tpu.protocol.trials import run_grid_pass
+
+    journal = TrialJournal(tmp_path / "j.jsonl", {"x": 1})
+    with pytest.raises(ValueError, match="continuous"):
+        run_grid_pass(
+            runner, "injection", [], lambda lf, c: None,
+            scheduler="batch", journal=journal, pass_key="p",
+        )
+    journal.discard()
+
+
+# --- judge outage: streaming pool defers, breaker opens ---------------------
+
+
+def _trial_results(n):
+    return [
+        {"concept": "Dust", "trial": i + 1, "response": "I sense dust",
+         "injected": True, "trial_type": "injection",
+         "layer_fraction": 0.5, "strength": 2.0}
+        for i in range(n)
+    ]
+
+
+class DownClient:
+    model_name = "down"
+
+    def grade(self, prompts):
+        raise RuntimeError("api down")
+
+
+class YesClient:
+    model_name = "yes"
+
+    def grade(self, prompts):
+        return ["Answer: YES"] * len(prompts)
+
+
+class FlakyClient:
+    model_name = "flaky"
+
+    def __init__(self, failures=1):
+        self.failures = failures
+        self.calls = 0
+
+    def grade(self, prompts):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise RuntimeError("blip")
+        return ["Answer: YES"] * len(prompts)
+
+
+def test_pool_outage_defers_to_journal(tmp_path):
+    journal = TrialJournal(tmp_path / "j.jsonl", {"x": 1})
+    breaker = CircuitBreaker(failure_threshold=2, cooldown_s=600)
+    pool = StreamingGradePool(
+        LLMJudge(client=DownClient()), max_workers=1,
+        journal=journal, pass_key="p", breaker=breaker,
+        max_attempts=2, retry_delay_s=0.0,
+    )
+    for i, r in enumerate(_trial_results(4)):
+        pool.submit(i, r)
+    graded, stats = pool.finish()
+    assert graded == {}
+    assert stats["deferred"] == 4
+    assert stats["deferred_trials"] == [0, 1, 2, 3]
+    assert stats["breaker_state"] == "open"
+    assert stats["degraded"] and all(
+        d["error"] in ("RuntimeError", "CircuitOpen")
+        for d in stats["degraded"]
+    )
+    assert sorted(journal.deferred("p")) == [0, 1, 2, 3]
+    assert journal.deferred_cells() == {(0.5, 2.0)}
+    journal.close()
+
+    # The deferral survives restart: a reopened journal still owes the cell.
+    j2 = TrialJournal(tmp_path / "j.jsonl", {"x": 1})
+    assert j2.deferred_cells() == {(0.5, 2.0)}
+    j2.close()
+
+
+def test_pool_retries_transient_failure_inline(tmp_path):
+    journal = TrialJournal(tmp_path / "j.jsonl", {"x": 1})
+    pool = StreamingGradePool(
+        LLMJudge(client=FlakyClient(failures=1)), max_workers=1,
+        journal=journal, pass_key="p",
+        max_attempts=3, retry_delay_s=0.0,
+    )
+    for i, r in enumerate(_trial_results(2)):
+        pool.submit(i, r)
+    graded, stats = pool.finish()
+    assert sorted(graded) == [0, 1]
+    assert all("evaluations" in graded[i] for i in graded)
+    assert stats["deferred"] == 0
+    # The transient failure still left a structured degraded record.
+    assert [d["attempt"] for d in stats["degraded"]] == [1]
+    assert sorted(journal.graded("p")) == [0, 1]
+    assert journal.deferred("p") == {}
+    journal.close()
+
+
+def test_pool_consumes_injected_judge_outage_in_order():
+    faults = FaultPlan(judge_timeout=1, judge_5xx=1)
+    pool = StreamingGradePool(
+        LLMJudge(client=YesClient()), max_workers=1,
+        faults=faults, max_attempts=3, retry_delay_s=0.0,
+    )
+    pool.submit(0, _trial_results(1)[0])
+    graded, stats = pool.finish()
+    assert sorted(graded) == [0]
+    assert [d["error"] for d in stats["degraded"]] == [
+        "InjectedJudgeTimeout", "InjectedJudgeServerError",
+    ]
+
+
+def test_circuit_breaker_transitions(monkeypatch):
+    import introspective_awareness_tpu.judge.streaming as streaming_mod
+
+    t = [1000.0]
+    monkeypatch.setattr(streaming_mod.time, "monotonic", lambda: t[0])
+    b = CircuitBreaker(failure_threshold=2, cooldown_s=10.0)
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    t[0] += 10.5
+    assert b.state == "half-open"
+    assert b.allow()       # the single half-open probe
+    assert not b.allow()   # concurrent second probe rejected
+    b.record_failure()     # probe failed -> re-open
+    assert b.state == "open" and not b.allow()
+    t[0] += 10.5
+    assert b.allow()
+    b.record_success()     # probe succeeded -> closed
+    assert b.state == "closed" and b.allow()
+
+
+def test_retry_after_header_parsing():
+    from introspective_awareness_tpu.judge.client import _retry_after_seconds
+
+    class Resp:
+        def __init__(self, headers):
+            self.headers = headers
+
+    class ApiError(Exception):
+        def __init__(self, headers=None):
+            if headers is not None:
+                self.response = Resp(headers)
+
+    assert _retry_after_seconds(ApiError({"retry-after": "7"})) == 7.0
+    assert _retry_after_seconds(ApiError({"Retry-After": "2.5"})) == 2.5
+    assert _retry_after_seconds(ApiError({"Retry-After": "500"})) == 120.0
+    assert _retry_after_seconds(ApiError({"retry-after": "-3"})) == 0.0
+    # HTTP-date form deliberately unhandled; absent header / response too.
+    assert _retry_after_seconds(
+        ApiError({"retry-after": "Wed, 21 Oct 2026 07:28:00 GMT"})
+    ) is None
+    assert _retry_after_seconds(ApiError({})) is None
+    assert _retry_after_seconds(ApiError()) is None
+
+
+# --- judge outage end-to-end: defer, finish, re-grade on resume -------------
+
+
+def test_judge_outage_defers_then_regrades_on_resume(tmp_path, monkeypatch, capsys):
+    """Sweep with a dead judge finishes decode-complete (exit 0): grading is
+    deferred to the journal, cells persist with keyword metrics, and the
+    journal is kept. A later run with a healthy judge grades the deferred
+    trials text-only — no model load — and discards the journal."""
+    import introspective_awareness_tpu.cli.sweep as sweep_mod
+
+    monkeypatch.setattr(
+        sweep_mod, "_build_judge",
+        lambda args, mesh, rules: LLMJudge(client=DownClient()),
+    )
+    argv = _argv(tmp_path, extra=["--judge-backend", "openai"])
+    assert main(argv) == 0
+    capsys.readouterr()
+    jpath = tmp_path / "out" / "tiny" / "trial_journal.jsonl"
+    assert jpath.exists()  # kept: it still owes the deferred grading
+    data = _cell_data(tmp_path / "out")
+    for cell in CELLS:
+        assert data[cell]["metrics"]["metrics_source"] == "keyword"
+        assert data[cell]["n_samples"] == 12  # responses never lost
+
+    # Judge recovered: the resume run must not need the subject model.
+    monkeypatch.setattr(
+        sweep_mod, "_build_judge",
+        lambda args, mesh, rules: LLMJudge(client=YesClient()),
+    )
+
+    def boom(*a, **k):
+        raise AssertionError("deferred re-grading must not load the model")
+
+    monkeypatch.setattr(sweep_mod, "load_subject", boom)
+    assert main(argv) == 0
+    out = capsys.readouterr().out
+    assert "grading deferred trials" in out
+    assert not jpath.exists()
+    data = _cell_data(tmp_path / "out")
+    for cell in CELLS:
+        assert data[cell]["metrics"]["metrics_source"] == "judge"
+        assert all("evaluations" in r for r in data[cell]["results"])

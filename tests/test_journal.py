@@ -1,0 +1,381 @@
+"""runtime/journal.py + runtime/faults.py + metrics.atomic_write unit tests.
+
+No model, no JAX compute: these exercise the durability primitives alone —
+CRC framing, torn-tail recovery, last-write-wins replay, config signature
+rejection, deterministic fault plans, and atomic artifact publication.
+"""
+
+import json
+import os
+import zlib
+from pathlib import Path
+
+import pytest
+
+from introspective_awareness_tpu.metrics import atomic_write
+from introspective_awareness_tpu.runtime.faults import (
+    FaultPlan,
+    InjectedCrash,
+    InjectedJudgeRateLimit,
+    InjectedJudgeServerError,
+    InjectedJudgeTimeout,
+)
+from introspective_awareness_tpu.runtime.journal import (
+    JournalConfigMismatch,
+    JournalError,
+    TrialJournal,
+    _frame,
+    _parse_line,
+)
+
+CFG = {"model": "tiny", "seed": 0, "concepts": ["Dust"]}
+
+
+def _mk(tmp_path, config=CFG, **kw) -> TrialJournal:
+    return TrialJournal(tmp_path / "trial_journal.jsonl", config, **kw)
+
+
+# --- framing -----------------------------------------------------------------
+
+
+def test_frame_roundtrip():
+    obj = {"ev": "decoded", "idx": 3, "pass": "fused/injection"}
+    assert _parse_line(_frame(obj)) == obj
+
+
+def test_parse_rejects_bad_crc_and_garbage():
+    good = _frame({"a": 1})
+    bad_crc = b"00000000" + good[8:]
+    assert _parse_line(bad_crc) is None
+    assert _parse_line(b"not a journal line\n") is None
+    assert _parse_line(b"") is None
+    # valid CRC over non-dict JSON is still rejected
+    data = b"[1,2,3]"
+    assert _parse_line(b"%08x " % zlib.crc32(data) + data + b"\n") is None
+
+
+# --- lifecycle + replay ------------------------------------------------------
+
+
+def test_fresh_journal_then_replay(tmp_path):
+    j = _mk(tmp_path)
+    assert not j.resumed and not j.has_state()
+    j.record_decoded("fused/injection", 0, {"response": "a"})
+    j.record_decoded("fused/injection", 1, {"response": "b"})
+    j.record_graded("fused/injection", 0, {"claims_detection": {"grade": 1}})
+    j.close()
+
+    j2 = _mk(tmp_path)
+    assert j2.resumed and j2.has_state()
+    assert j2.decoded("fused/injection") == {
+        0: {"response": "a"}, 1: {"response": "b"},
+    }
+    assert j2.graded("fused/injection") == {
+        0: {"claims_detection": {"grade": 1}},
+    }
+    assert j2.decoded("fused/control") == {}
+    g = j2.gauges
+    assert g.replayed_records == 3
+    assert g.recovered_trials == 2 and g.recovered_grades == 1
+    assert g.torn_records_dropped == 0
+    j2.close()
+
+
+def test_empty_file_is_fresh(tmp_path):
+    path = tmp_path / "trial_journal.jsonl"
+    path.write_bytes(b"")
+    j = _mk(tmp_path)
+    # Zero-byte file: nothing to replay, journal starts fresh.
+    assert not j.resumed and not j.has_state()
+    j.close()
+
+
+def test_torn_first_write_is_fresh(tmp_path):
+    path = tmp_path / "trial_journal.jsonl"
+    path.write_bytes(b"0a3f")  # kill mid-way through the very first record
+    j = _mk(tmp_path)
+    assert not j.has_state()
+    j.record_decoded("p", 0, {"response": "x"})
+    j.close()
+    j2 = _mk(tmp_path)
+    assert j2.decoded("p") == {0: {"response": "x"}}
+    j2.close()
+
+
+def test_torn_tail_dropped_and_truncated(tmp_path):
+    j = _mk(tmp_path)
+    j.record_decoded("p", 0, {"response": "keep"})
+    j.record_decoded("p", 1, {"response": "doomed"})
+    j.close()
+    path = j.path
+    raw = path.read_bytes()
+    # Shear the final record mid-line, as a kill during write() would.
+    path.write_bytes(raw[: len(raw) - 20])
+
+    j2 = _mk(tmp_path)
+    assert j2.decoded("p") == {0: {"response": "keep"}}
+    assert j2.gauges.torn_records_dropped == 1
+    # The file was truncated back to its valid prefix; appends go after it.
+    j2.record_decoded("p", 2, {"response": "after"})
+    j2.close()
+    j3 = _mk(tmp_path)
+    assert set(j3.decoded("p")) == {0, 2}
+    assert j3.gauges.torn_records_dropped == 0
+    j3.close()
+
+
+def test_midfile_corruption_raises(tmp_path):
+    j = _mk(tmp_path)
+    j.record_decoded("p", 0, {"response": "a"})
+    j.record_decoded("p", 1, {"response": "b"})
+    j.close()
+    lines = j.path.read_bytes().splitlines(keepends=True)
+    lines[1] = b"XXXX corrupt line\n"  # valid records follow -> not a torn tail
+    j.path.write_bytes(b"".join(lines))
+    with pytest.raises(JournalError, match="corrupt record at line 2"):
+        _mk(tmp_path)
+
+
+def test_duplicate_records_last_write_wins(tmp_path):
+    j = _mk(tmp_path)
+    j.record_decoded("p", 0, {"response": "old"})
+    j.record_decoded("p", 0, {"response": "new"})
+    j.record_graded("p", 0, {"v": 1})
+    j.record_graded("p", 0, {"v": 2})
+    j.close()
+    j2 = _mk(tmp_path)
+    assert j2.decoded("p")[0] == {"response": "new"}
+    assert j2.graded("p")[0] == {"v": 2}
+    j2.close()
+
+
+def test_config_mismatch_rejected(tmp_path):
+    j = _mk(tmp_path)
+    j.record_decoded("p", 0, {"response": "a"})
+    j.close()
+    with pytest.raises(JournalConfigMismatch, match="seed"):
+        _mk(tmp_path, config={**CFG, "seed": 1})
+    with pytest.raises(JournalConfigMismatch, match="--overwrite"):
+        _mk(tmp_path, config={**CFG, "concepts": ["Dust", "Trees"]})
+    # Same config still resumes fine.
+    j2 = _mk(tmp_path)
+    assert j2.resumed
+    j2.close()
+
+
+def test_not_a_journal_rejected(tmp_path):
+    path = tmp_path / "trial_journal.jsonl"
+    path.write_bytes(_frame({"ev": "decoded", "pass": "p", "idx": 0,
+                             "result": {}}))
+    with pytest.raises(JournalError, match="not a trial journal"):
+        _mk(tmp_path)
+
+
+def test_unknown_event_skipped(tmp_path):
+    j = _mk(tmp_path)
+    j.record_decoded("p", 0, {"response": "a"})
+    j.close()
+    with open(j.path, "ab") as f:
+        f.write(_frame({"ev": "from_the_future", "x": 1}))
+    j2 = _mk(tmp_path)  # a newer writer's records must not brick the reader
+    assert j2.decoded("p") == {0: {"response": "a"}}
+    j2.close()
+
+
+# --- deferred grading + clean stop ------------------------------------------
+
+
+def test_deferred_then_graded_resolves(tmp_path):
+    j = _mk(tmp_path)
+    j.record_decoded("p", 0, {"response": "a", "layer_fraction": 0.5,
+                              "strength": 2.0})
+    j.record_deferred("p", 0, "Timeout: judge down", 3, cell=(0.5, 2.0))
+    assert j.deferred("p") == {0: j.deferred("p")[0]}
+    assert j.deferred_cells() == {(0.5, 2.0)}
+    assert j.gauges.deferred_grades == 1
+    j.record_graded("p", 0, {"v": 1})
+    assert j.deferred("p") == {}
+    assert j.deferred_cells() == set()
+    j.close()
+    j2 = _mk(tmp_path)
+    assert j2.deferred("p") == {} and j2.deferred_cells() == set()
+    j2.close()
+
+
+def test_cell_regraded_marker(tmp_path):
+    j = _mk(tmp_path)
+    j.record_deferred("posthoc", -1, "APIError: 503", 1, cell=(0.25, 8.0))
+    assert j.deferred_cells() == {(0.25, 8.0)}
+    j.record_cell_regraded((0.25, 8.0))
+    assert j.deferred_cells() == set()
+    j.close()
+    j2 = _mk(tmp_path)
+    assert j2.deferred_cells() == set()
+    j2.close()
+
+
+def test_clean_stop_marker(tmp_path):
+    j = _mk(tmp_path)
+    j.record_decoded("p", 0, {"response": "a"})
+    j.record_clean_stop()
+    j.close()
+    j2 = _mk(tmp_path)
+    assert j2.was_clean_stop
+    j2.close()
+
+
+# --- compaction + discard ----------------------------------------------------
+
+
+def test_compact_drops_superseded_and_resolved(tmp_path):
+    j = _mk(tmp_path)
+    for _ in range(5):  # superseded duplicates
+        j.record_decoded("p", 0, {"response": "dup"})
+    j.record_decoded("p", 1, {"response": "live"})
+    j.record_deferred("p", 1, "boom", 1, cell=(0.5, 2.0))
+    j.record_graded("p", 1, {"v": 1})  # resolves the deferral
+    size_before = j.path.stat().st_size
+    j.compact()
+    assert j.path.stat().st_size < size_before
+    # Still appendable after rotation.
+    j.record_decoded("p", 2, {"response": "post"})
+    j.close()
+    j2 = _mk(tmp_path)
+    assert set(j2.decoded("p")) == {0, 1, 2}
+    assert j2.graded("p") == {1: {"v": 1}}
+    assert j2.deferred("p") == {} and j2.deferred_cells() == set()
+    j2.close()
+
+
+def test_discard_removes_file(tmp_path):
+    j = _mk(tmp_path)
+    j.record_decoded("p", 0, {"response": "a"})
+    j.discard()
+    assert not j.path.exists()
+
+
+def test_fsync_batching_still_flushes_every_record(tmp_path):
+    # flush() on every append means the OS sees each record even between
+    # fsyncs — a same-host reader observes all of them.
+    j = _mk(tmp_path, fsync_every=1000)
+    for i in range(10):
+        j.record_decoded("p", i, {"response": str(i)})
+    raw = j.path.read_bytes()
+    assert raw.count(b"\n") == 11  # start + 10 records
+    j.close()
+
+
+# --- FaultPlan ---------------------------------------------------------------
+
+
+def test_faultplan_from_spec():
+    p = FaultPlan.from_spec("crash_after_chunks=3,judge_timeout=2,torn_tail")
+    assert p.crash_after_chunks == 3
+    assert p.judge_timeout == 2
+    assert p.torn_tail == 1  # bare key means 1
+    assert p.crash_on_admission == 0
+    assert FaultPlan.from_spec("judge-5xx=4").judge_5xx == 4  # dashes ok
+    with pytest.raises(ValueError, match="unknown fault"):
+        FaultPlan.from_spec("explode=1")
+
+
+def test_faultplan_from_env(monkeypatch):
+    monkeypatch.delenv("IAT_FAULTS", raising=False)
+    assert FaultPlan.from_env() is None
+    monkeypatch.setenv("IAT_FAULTS", "crash_after_chunks=2")
+    assert FaultPlan.from_env().crash_after_chunks == 2
+
+
+def test_faultplan_tick_thresholds():
+    p = FaultPlan(crash_after_chunks=3, crash_on_admission=2)
+    p.tick("chunk"); p.tick("chunk")
+    p.tick("admission")
+    with pytest.raises(InjectedCrash, match="admission 2"):
+        p.tick("admission")
+    with pytest.raises(InjectedCrash, match="chunk 3"):
+        p.tick("chunk")
+    # Thresholds fire exactly once (counters keep advancing past them).
+    p.tick("chunk"); p.tick("admission")
+    with pytest.raises(ValueError):
+        p.tick("nonsense")
+
+
+def test_faultplan_judge_failure_order():
+    p = FaultPlan(judge_timeout=1, judge_rate_limit=1, judge_5xx=1)
+    assert isinstance(p.judge_failure(), InjectedJudgeTimeout)
+    assert isinstance(p.judge_failure(), InjectedJudgeRateLimit)
+    assert isinstance(p.judge_failure(), InjectedJudgeServerError)
+    assert p.judge_failure() is None
+    assert p.judge_failure() is None  # stays exhausted
+
+
+def test_faultplan_tear_tail(tmp_path):
+    path = tmp_path / "j.jsonl"
+    j = TrialJournal(path, CFG)
+    j.record_decoded("p", 0, {"response": "keep"})
+    j.record_decoded("p", 1, {"response": "shear me please, a long record"})
+    j.close()
+    assert FaultPlan().tear_tail(path) == 0  # torn_tail unset -> no-op
+    removed = FaultPlan(torn_tail=1).tear_tail(path)
+    assert removed > 0
+    j2 = TrialJournal(path, CFG)
+    assert j2.decoded("p") == {0: {"response": "keep"}}
+    assert j2.gauges.torn_records_dropped == 1
+    j2.close()
+
+
+# --- atomic_write ------------------------------------------------------------
+
+
+def test_atomic_write_publishes_complete_file(tmp_path):
+    target = tmp_path / "sub" / "results.json"
+    with atomic_write(target) as f:
+        json.dump({"ok": True}, f)
+    assert json.loads(target.read_text()) == {"ok": True}
+    assert not target.with_name(target.name + ".tmp").exists()
+
+
+def test_atomic_write_failure_leaves_target_untouched(tmp_path):
+    target = tmp_path / "results.json"
+    target.write_text('{"old": 1}')
+    with pytest.raises(RuntimeError, match="mid-write"):
+        with atomic_write(target) as f:
+            f.write('{"new": ')
+            raise RuntimeError("simulated crash mid-write")
+    assert json.loads(target.read_text()) == {"old": 1}
+    assert not target.with_name(target.name + ".tmp").exists()
+
+
+def test_save_evaluation_results_is_atomic(tmp_path, monkeypatch):
+    from introspective_awareness_tpu.metrics import (
+        persistence,
+        save_evaluation_results,
+    )
+
+    target = tmp_path / "results.json"
+    save_evaluation_results([{"response": "v1"}], target)
+    before = target.read_bytes()
+
+    real_replace = os.replace
+    def boom(src, dst):
+        raise OSError("disk gone")
+    monkeypatch.setattr(persistence.os, "replace", boom)
+    with pytest.raises(OSError):
+        save_evaluation_results([{"response": "v2"}], target)
+    monkeypatch.setattr(persistence.os, "replace", real_replace)
+    # The marker file is either the old complete version or the new one —
+    # never a truncated hybrid.
+    assert target.read_bytes() == before
+
+
+def test_results_to_csv_escapes_nul_bytes(tmp_path):
+    from introspective_awareness_tpu.metrics import results_to_csv
+
+    # Sampled byte-tokenizer responses can contain NULs, which the csv
+    # module cannot frame; the artifact write must escape, not crash.
+    results_to_csv(
+        [{"concept": "Dust", "response": "bad\x00byte"}],
+        tmp_path / "results.csv",
+    )
+    text = (tmp_path / "results.csv").read_text()
+    assert "bad\\x00byte" in text and "\x00" not in text
