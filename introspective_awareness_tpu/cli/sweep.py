@@ -612,8 +612,13 @@ def _cell_metrics(
             attempt=1,
         )
         if journal is not None:
+            # Keyed per cell: deferral replay is last-write-wins on
+            # (pass, key), so a shared key (e.g. a constant -1) would let a
+            # multi-cell judge outage keep only the LAST failed cell and
+            # silently never re-grade the others on resume.
             journal.record_deferred(
-                "posthoc", -1, f"{error}: {detail[:200]}", 1,
+                "posthoc", f"cell/{lf}/{strength}",
+                f"{error}: {detail[:200]}", 1,
                 cell=(lf, strength),
             )
         return _keyword_metrics(results)

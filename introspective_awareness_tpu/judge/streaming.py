@@ -114,7 +114,10 @@ class StreamingGradePool:
     workers, and returns ``(graded, stats)`` where ``graded`` maps queue
     index → result-with-``evaluations`` (order restoration is the caller's
     one-liner: iterate indices in queue order) and ``stats`` quantifies the
-    decode/grading overlap. Single-use: one pool per scheduler run.
+    decode/grading overlap. ``submit``'s ``journal_key`` is the stable
+    trial-identity key the journal records are written under (queue indices
+    are not stable across resumed runs); it defaults to the queue index for
+    journal-less or test use. Single-use: one pool per scheduler run.
     """
 
     def __init__(
@@ -155,14 +158,16 @@ class StreamingGradePool:
 
     # -- producer side (scheduler thread) -----------------------------------
 
-    def submit(self, idx: int, result: dict) -> None:
+    def submit(self, idx: int, result: dict, journal_key=None) -> None:
         """Queue one finished trial result (must carry ``response``,
         ``concept``, ``trial``, ``trial_type`` — the fields the two-stage
-        judge flow and prompt reconstruction read)."""
+        judge flow and prompt reconstruction read). ``journal_key`` is the
+        stable key graded/deferred journal records are written under;
+        defaults to ``idx``."""
         if self._finished:
             raise RuntimeError("StreamingGradePool already finished")
         self._submitted += 1
-        self._q.put((idx, result))
+        self._q.put((idx, idx if journal_key is None else journal_key, result))
 
     # -- worker side --------------------------------------------------------
 
@@ -184,11 +189,14 @@ class StreamingGradePool:
                     self._q.put(_STOP)  # hand the sentinel to a sibling
                     break
                 batch.append(nxt)
-            idxs = [i for i, _ in batch]
-            results = [r for _, r in batch]
-            self._grade_batch(idxs, results)
+            idxs = [i for i, _, _ in batch]
+            keys = [k for _, k, _ in batch]
+            results = [r for _, _, r in batch]
+            self._grade_batch(idxs, keys, results)
 
-    def _grade_batch(self, idxs: list[int], results: list[dict]) -> None:
+    def _grade_batch(
+        self, idxs: list[int], keys: list, results: list[dict]
+    ) -> None:
         """Grade one micro-batch with inline retries; defer on exhaustion.
 
         Retrying here (rather than requeueing) keeps the ``_STOP``
@@ -198,7 +206,7 @@ class StreamingGradePool:
         attempts = 0
         while True:
             if self.breaker is not None and not self.breaker.allow():
-                self._defer(idxs, results, "CircuitOpen",
+                self._defer(idxs, keys, results, "CircuitOpen",
                             "judge circuit open; deferring to post-hoc",
                             attempts)
                 return
@@ -224,7 +232,7 @@ class StreamingGradePool:
                         "attempt": attempts,
                     })
                 if attempts >= self.max_attempts:
-                    self._defer(idxs, results, type(e).__name__,
+                    self._defer(idxs, keys, results, type(e).__name__,
                                 str(e)[:200], attempts)
                     return
                 if self.retry_delay_s:
@@ -238,22 +246,22 @@ class StreamingGradePool:
                 for i, ev in zip(idxs, evaluated):
                     self._graded[i] = ev
             if self.journal is not None:
-                for i, ev in zip(idxs, evaluated):
+                for k, ev in zip(keys, evaluated):
                     self.journal.record_graded(
-                        self.pass_key, i, ev["evaluations"]
+                        self.pass_key, k, ev["evaluations"]
                     )
             return
 
     def _defer(
-        self, idxs: list[int], results: list[dict],
+        self, idxs: list[int], keys: list, results: list[dict],
         error: str, detail: str, attempts: int,
     ) -> None:
         with self._lock:
             self._deferred.extend(idxs)
         if self.journal is not None:
-            for i, r in zip(idxs, results):
+            for k, r in zip(keys, results):
                 self.journal.record_deferred(
-                    self.pass_key, i, f"{error}: {detail}", attempts,
+                    self.pass_key, k, f"{error}: {detail}", attempts,
                     cell=(r.get("layer_fraction"), r.get("strength")),
                 )
 

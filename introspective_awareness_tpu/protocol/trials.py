@@ -170,10 +170,18 @@ def run_grid_pass(
     appended to the journal under ``pass_key`` before grading, and on entry
     trials the journal already holds are *replayed* — skipped in the
     scheduler queue, resubmitted to the grade pool only if their verdict is
-    missing. The remainder runs with its original queue indices as
-    ``trial_ids`` so the per-trial PRNG streams — and therefore sampled
-    text — are bit-identical to an uninterrupted run. ``stop_event`` turns
-    SIGTERM-style shutdown into a drained, journaled
+    missing. Journal records are keyed by trial IDENTITY (concept, trial
+    number, layer fraction, strength), not queue position, so replay stays
+    correct even when the caller's task list shrank between runs (the fused
+    sweep rebuilds it from still-unsaved cells; a crash mid-way through the
+    per-cell save loop leaves a shorter list on resume). The remainder runs
+    with its original queue indices as ``trial_ids`` so the per-trial PRNG
+    streams — and therefore sampled text — are bit-identical to an
+    uninterrupted run; if the task list DID change, re-decoded trials get
+    indices from the new list, so sampled bit-identity is only guaranteed
+    for replayed trials there (the journal is fsynced at end of pass before
+    any cell can be saved, so a changed list normally replays everything).
+    ``stop_event`` turns SIGTERM-style shutdown into a drained, journaled
     :class:`~introspective_awareness_tpu.runtime.journal.SweepInterrupted`;
     ``faults`` threads the deterministic fault plan through.
     """
@@ -224,25 +232,41 @@ def run_grid_pass(
         N = len(tasks)
         ledger = getattr(runner, "ledger", None)
 
+        # Stable trial identity: the journal key. Queue position is NOT
+        # stable across runs (the fused task list is rebuilt from unsaved
+        # cells), so keying by it would misattribute replayed records after
+        # a crash mid-save-loop. repr() of the floats is deterministic for
+        # the same parsed CLI args on both runs.
+        def _tid(i: int) -> str:
+            concept, trial_num, lf, _layer_idx, strength = tasks[i]
+            return f"{concept}|{trial_num}|{lf!r}|{strength!r}"
+
+        tids = [_tid(i) for i in range(N)]
+
         # Journal replay: trials a previous (crashed or stopped) run already
         # decoded under this pass_key skip the scheduler entirely; only the
         # remainder is enqueued, keeping its ORIGINAL queue indices as
         # trial_ids so PRNG streams line up with the uninterrupted run.
-        recovered: dict[int, dict] = {}
-        jgraded: dict[int, dict] = {}
+        recovered: dict[str, dict] = {}
+        jgraded: dict[str, dict] = {}
         if journal is not None:
             recovered = journal.decoded(pass_key)
             jgraded = journal.graded(pass_key)
-        remaining = [i for i in range(N) if i not in recovered]
+        remaining = [i for i in range(N) if tids[i] not in recovered]
         pos_of = {i: j for j, i in enumerate(remaining)}
         if journal is not None and recovered:
             journal.gauges.requeued_trials += len(remaining)
+            # Journaled trials absent from this task list: the list changed
+            # (their cells were saved before the crash). Harmless for replay
+            # (identity keys never misattribute), but worth surfacing.
+            stale = len(set(recovered) - set(tids))
             if ledger is not None:
                 ledger.event(
                     "journal_recovery", pass_key=pass_key,
-                    recovered=len(recovered),
+                    recovered=len(recovered) - stale,
                     recovered_graded=len(jgraded),
                     requeued=len(remaining),
+                    stale_records=stale,
                 )
 
         streamed: dict[int, dict] = {}
@@ -256,17 +280,19 @@ def run_grid_pass(
                 # decoded-but-ungraded record, which resume re-grades — never
                 # a graded-but-unjournaled decode.
                 if journal is not None:
-                    journal.record_decoded(pass_key, i, r)
+                    journal.record_decoded(pass_key, tids[i], r)
                 if grade_pool is not None:
-                    grade_pool.submit(i, r)
+                    grade_pool.submit(i, r, journal_key=tids[i])
 
         # Recovered trials whose verdict didn't make it into the journal are
         # resubmitted up front, so their grading overlaps the remainder's
         # decode just like fresh trials.
         if grade_pool is not None:
-            for i, r in recovered.items():
-                if i not in jgraded:
-                    grade_pool.submit(i, r)
+            for i in range(N):
+                if tids[i] in recovered and tids[i] not in jgraded:
+                    grade_pool.submit(
+                        i, recovered[tids[i]], journal_key=tids[i]
+                    )
 
         responses: list[str] = []
         if remaining:
@@ -300,15 +326,21 @@ def run_grid_pass(
         if grade_pool is None:
             out = []
             for i in range(N):
-                if i in recovered:
-                    r = dict(recovered[i])
-                    if i in jgraded:
-                        r["evaluations"] = jgraded[i]
+                if tids[i] in recovered:
+                    r = dict(recovered[tids[i]])
+                    if tids[i] in jgraded:
+                        r["evaluations"] = jgraded[tids[i]]
                     out.append(r)
                 elif i in streamed:
                     out.append(streamed[i])
                 else:
                     out.append(make_result(i, responses[pos_of[i]]))
+            if journal is not None:
+                # One fsync per pass: by the time any cell's results.json can
+                # be written, every decoded record of this pass is durable —
+                # so a crash during the save loop never loses trials that a
+                # shrunken resume list would have to re-decode off-stream.
+                journal.flush()
             return out
         # Join the grading workers and restore queue order: pool-graded where
         # it finished, journal-recovered (with any recovered verdict) next,
@@ -323,15 +355,17 @@ def run_grid_pass(
         for i in range(N):
             if i in graded:
                 out.append(graded[i])
-            elif i in recovered:
-                r = dict(recovered[i])
-                if i in jgraded:
-                    r["evaluations"] = jgraded[i]
+            elif tids[i] in recovered:
+                r = dict(recovered[tids[i]])
+                if tids[i] in jgraded:
+                    r["evaluations"] = jgraded[tids[i]]
                 out.append(r)
             elif i in streamed:
                 out.append(streamed[i])
             else:
                 out.append(make_result(i, responses[pos_of[i]]))
+        if journal is not None:
+            journal.flush()  # pass complete & durable before any cell save
         return out
 
     results: list[dict] = []

@@ -13,6 +13,16 @@ the remainder re-enters the scheduler — with their ORIGINAL queue indices,
 so the per-trial queue-indexed PRNG streams (and therefore greedy AND
 sampled outputs) are bit-identical to an uninterrupted run.
 
+Records are keyed by ``(pass_key, trial key)`` where the trial key is an
+OPAQUE identifier chosen by the caller. The protocol layer uses a stable
+trial-identity string (concept, trial number, layer fraction, strength) —
+NOT the queue index — because the fused sweep rebuilds its task list from
+the still-unsaved cells on each run: after a crash mid-way through the
+per-cell save loop the resumed task list is shorter, and index-keyed
+records would replay against the wrong trials. Identity keys make replay
+independent of task-list shape; journaled trials from already-saved cells
+are simply ignored.
+
 Framing: each line is ``<crc32 hex8> <compact-json>\\n``. The CRC covers
 the JSON bytes, so a record either replays verbatim or is detectably
 corrupt. Recovery is torn-tail-tolerant: a kill mid-``write`` leaves at
@@ -96,7 +106,11 @@ class TrialJournal:
     ``decoded`` records.
     """
 
-    SCHEMA = 1
+    # Schema 2: trial keys are opaque caller-chosen identifiers (the
+    # protocol layer uses identity strings); schema 1 keyed by queue index,
+    # which misattributes records when the task list changes between runs —
+    # refuse to replay it.
+    SCHEMA = 2
 
     def __init__(
         self,
@@ -110,10 +124,11 @@ class TrialJournal:
         self.gauges = RecoveryGauges()
         self._lock = threading.Lock()
         self._unsynced = 0
-        # Replayed state: pass_key -> {trial queue index -> payload}.
-        self._decoded: dict[str, dict[int, dict]] = {}
-        self._graded: dict[str, dict[int, dict]] = {}
-        self._deferred: dict[str, dict[int, dict]] = {}
+        # Replayed state: pass_key -> {trial key -> payload}. Trial keys are
+        # opaque (str or int) and pass through JSON unchanged.
+        self._decoded: dict[str, dict] = {}
+        self._graded: dict[str, dict] = {}
+        self._deferred: dict[str, dict] = {}
         self._regraded_cells: set[tuple[float, float]] = set()
         self.was_clean_stop = False
         self.resumed = False
@@ -182,6 +197,13 @@ class TrialJournal:
                 f"{self.path}: first record is {head.get('ev')!r}, not the "
                 f"'start' config signature — not a trial journal"
             )
+        if head.get("schema") != self.SCHEMA:
+            raise JournalConfigMismatch(
+                f"{self.path} uses journal schema {head.get('schema')!r}, "
+                f"this writer uses {self.SCHEMA} — record keying differs, so "
+                f"replaying could misattribute trials. Pass --overwrite to "
+                f"discard the journal (its trials re-decode)."
+            )
         if head.get("config") != self.config:
             theirs = head.get("config") or {}
             diff = sorted(
@@ -203,28 +225,31 @@ class TrialJournal:
         self.gauges.recovered_grades = sum(
             len(m) for m in self._graded.values()
         )
-        self.was_clean_stop = self._saw_clean_stop
-
-    _saw_clean_stop = False
+        # A clean stop is only trusted as the FINAL record: anything appended
+        # after it (a later resume's records, then a hard crash) supersedes
+        # it — otherwise one graceful stop would report was_clean_stop
+        # forever.
+        self.was_clean_stop = records[-1].get("ev") == "clean_stop"
+        self.gauges.clean_stop = self.was_clean_stop
 
     def _apply(self, rec: dict) -> None:
         ev = rec.get("ev")
         if ev == "decoded":
-            self._decoded.setdefault(rec["pass"], {})[int(rec["idx"])] = (
+            self._decoded.setdefault(rec["pass"], {})[rec["idx"]] = (
                 rec["result"]
             )
         elif ev == "graded":
-            self._graded.setdefault(rec["pass"], {})[int(rec["idx"])] = (
+            self._graded.setdefault(rec["pass"], {})[rec["idx"]] = (
                 rec["evaluations"]
             )
         elif ev == "grade_deferred":
-            self._deferred.setdefault(rec["pass"], {})[int(rec["idx"])] = rec
+            self._deferred.setdefault(rec["pass"], {})[rec["idx"]] = rec
         elif ev == "cell_regraded":
             self._regraded_cells.add(tuple(rec["cell"]))
         elif ev == "clean_stop":
-            self._saw_clean_stop = True
+            pass  # positional: only meaningful as the final record (above)
         # Unknown events are skipped: a newer writer's records must not
-        # brick an older reader (schema gate lives in the start record).
+        # brick an older reader (the schema gate is in the start record).
 
     # -- append --------------------------------------------------------------
 
@@ -236,39 +261,45 @@ class TrialJournal:
             os.fsync(self._f.fileno())
             self._unsynced = 0
 
-    def record_decoded(self, pass_key: str, idx: int, result: dict) -> None:
-        """One trial finalized by the scheduler (from ``result_cb``)."""
+    def record_decoded(self, pass_key: str, idx, result: dict) -> None:
+        """One trial finalized by the scheduler (from ``result_cb``).
+
+        ``idx`` is the caller's opaque trial key (identity string or int);
+        it must be stable across runs and unique within the pass.
+        """
         with self._lock:
-            self._append({"ev": "decoded", "pass": pass_key, "idx": int(idx),
+            self._append({"ev": "decoded", "pass": pass_key, "idx": idx,
                           "result": result})
-            self._decoded.setdefault(pass_key, {})[int(idx)] = result
+            self._decoded.setdefault(pass_key, {})[idx] = result
 
     def record_graded(
-        self, pass_key: str, idx: int, evaluations: dict
+        self, pass_key: str, idx, evaluations: dict
     ) -> None:
         """One trial graded (streaming pool worker or post-hoc path)."""
         with self._lock:
-            self._append({"ev": "graded", "pass": pass_key, "idx": int(idx),
+            self._append({"ev": "graded", "pass": pass_key, "idx": idx,
                           "evaluations": evaluations})
-            self._graded.setdefault(pass_key, {})[int(idx)] = evaluations
-            self._deferred.get(pass_key, {}).pop(int(idx), None)
+            self._graded.setdefault(pass_key, {})[idx] = evaluations
+            self._deferred.get(pass_key, {}).pop(idx, None)
 
     def record_deferred(
         self,
         pass_key: str,
-        idx: int,
+        idx,
         error: str,
         attempts: int,
         cell: Optional[tuple[float, float]] = None,
     ) -> None:
         """Grading gave up on a trial (circuit open / retries exhausted);
-        queue it for post-hoc grading on resume."""
-        rec = {"ev": "grade_deferred", "pass": pass_key, "idx": int(idx),
+        queue it for post-hoc grading on resume. ``idx`` must be unique per
+        deferred unit within the pass — colliding keys last-write-wins and
+        would silently drop earlier deferrals."""
+        rec = {"ev": "grade_deferred", "pass": pass_key, "idx": idx,
                "error": error, "attempts": int(attempts),
                "cell": None if cell is None else list(cell)}
         with self._lock:
             self._append(rec)
-            self._deferred.setdefault(pass_key, {})[int(idx)] = rec
+            self._deferred.setdefault(pass_key, {})[idx] = rec
             self.gauges.deferred_grades += 1
 
     def record_cell_regraded(self, cell: tuple[float, float]) -> None:
@@ -303,15 +334,15 @@ class TrialJournal:
 
     # -- replayed-state accessors -------------------------------------------
 
-    def decoded(self, pass_key: str) -> dict[int, dict]:
-        """queue index -> decoded result dict, for one pass."""
+    def decoded(self, pass_key: str) -> dict:
+        """trial key -> decoded result dict, for one pass."""
         return dict(self._decoded.get(pass_key, {}))
 
-    def graded(self, pass_key: str) -> dict[int, dict]:
-        """queue index -> evaluations dict, for one pass."""
+    def graded(self, pass_key: str) -> dict:
+        """trial key -> evaluations dict, for one pass."""
         return dict(self._graded.get(pass_key, {}))
 
-    def deferred(self, pass_key: str) -> dict[int, dict]:
+    def deferred(self, pass_key: str) -> dict:
         """Deferred-and-not-since-graded trials for one pass."""
         out = {}
         for idx, rec in self._deferred.get(pass_key, {}).items():
@@ -344,20 +375,22 @@ class TrialJournal:
             with open(tmp, "wb") as f:
                 f.write(_frame({"ev": "start", "schema": self.SCHEMA,
                                 "config": self.config}))
+                # Trial keys are opaque (str or int may coexist across
+                # passes): sort by string form for a deterministic rotation.
                 for pass_key in sorted(self._decoded):
-                    for idx in sorted(self._decoded[pass_key]):
+                    for idx in sorted(self._decoded[pass_key], key=str):
                         f.write(_frame({
                             "ev": "decoded", "pass": pass_key, "idx": idx,
                             "result": self._decoded[pass_key][idx],
                         }))
                 for pass_key in sorted(self._graded):
-                    for idx in sorted(self._graded[pass_key]):
+                    for idx in sorted(self._graded[pass_key], key=str):
                         f.write(_frame({
                             "ev": "graded", "pass": pass_key, "idx": idx,
                             "evaluations": self._graded[pass_key][idx],
                         }))
                 for pass_key in sorted(self._deferred):
-                    for idx in sorted(self._deferred[pass_key]):
+                    for idx in sorted(self._deferred[pass_key], key=str):
                         if idx in self._graded.get(pass_key, {}):
                             continue
                         rec = self._deferred[pass_key][idx]

@@ -630,7 +630,13 @@ class ModelRunner:
         names each queue row's PRNG stream explicitly — a resumed sweep
         passes the *original* queue indices of the remaining trials so
         their sampled text is bit-identical to the uninterrupted run
-        regardless of how many trials were already recovered. A set
+        regardless of how many trials were already recovered. EXCEPTION:
+        the fixed-batch fallback below (ineligible queues, ``L0 == 0``) has
+        no per-trial streams — each batch call samples from one joint key
+        determined by batch composition, and a resumed subset composes its
+        chunks differently — so at temperature > 0 resumed sampled text on
+        that path is NOT bit-identical (greedy still is); a ledger event
+        flags it when it happens. A set
         ``stop_event`` drains in-flight chunks and raises
         :class:`SweepInterrupted` (partial work reaches ``result_cb``
         first, so the caller's journal is complete up to the stop).
@@ -699,6 +705,21 @@ class ModelRunner:
             # is exact; at temp > 0 batch composition determines each row's
             # sample stream (one joint key per call), the same caveat the
             # slot-sized chunking itself already carries on this path.
+            if (
+                trial_ids is not None
+                and temperature > 0
+                and list(trial_ids) != list(range(N))
+            ):
+                # Journal-resumed subset: this path ignores trial_ids, so the
+                # re-decoded trials' sampled text will differ from the
+                # uninterrupted run (chunk composition changed). Decode is
+                # still correct — only the bit-identity guarantee is weaker
+                # here; make that visible instead of silently claiming it.
+                self.ledger.event(
+                    "fallback_resume_sampled_divergence",
+                    trials=N, temperature=float(temperature),
+                    model=self.model_name,
+                )
             out: list[Optional[str]] = [None] * N
             for b in sorted(set(budget_list)):
                 idx = [i for i in range(N) if budget_list[i] == b]

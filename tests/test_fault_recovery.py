@@ -200,6 +200,51 @@ def test_graceful_stop_drains_then_resume_matches(tmp_path, runner):
     resumed.discard()
 
 
+def test_resume_with_shrunken_task_list_replays_by_identity(tmp_path, runner):
+    """Crash mid-way through the fused per-cell save loop: some cells'
+    results.json were written, so the resumed run rebuilds a SHORTER task
+    list. Journal records are keyed by trial identity, so replay must
+    attribute every recovered trial to the right task — index keying would
+    misalign here and corrupt the still-unsaved cells' artifacts."""
+    from introspective_awareness_tpu.protocol.trials import run_grid_pass
+
+    rng = np.random.default_rng(1)
+    vec = {c: rng.normal(size=runner.cfg.hidden_size).astype(np.float32)
+           for c in ("Dust", "Trees")}
+    # Two cells fused into one pass, cell A's tasks queued first.
+    tasks = [(c, t, lf, 1, s)
+             for lf, s in ((0.25, 2.0), (0.75, 8.0))
+             for c in ("Dust", "Trees")
+             for t in (1, 2)]
+    kw = dict(max_new_tokens=6, temperature=1.0, batch_size=2, seed=7,
+              scheduler="continuous")
+
+    cfg_sig = {"grid": "shrink-test"}
+    jpath = tmp_path / "trial_journal.jsonl"
+    journal = TrialJournal(jpath, cfg_sig)
+    ref = run_grid_pass(
+        runner, "injection", tasks, lambda lf, c: vec[c],
+        journal=journal, pass_key="fused/injection", **kw
+    )
+    journal.close()  # decode complete; "crash" after cell A's save
+
+    # Resume sees only cell B's tasks (cell A's results.json exists).
+    sub = [t for t in tasks if t[2] == 0.75]
+    resumed = TrialJournal(jpath, cfg_sig)
+    out = run_grid_pass(
+        runner, "injection", sub, lambda lf, c: vec[c],
+        journal=resumed, pass_key="fused/injection", **kw
+    )
+    # Pure replay: every subset trial was journaled, nothing re-decodes.
+    assert resumed.gauges.requeued_trials == 0
+    ref_by_id = {
+        (r["concept"], r["trial"], r["layer_fraction"], r["strength"]): r
+        for r in ref
+    }
+    assert out == [ref_by_id[(c, t, lf, s)] for c, t, lf, _li, s in sub]
+    resumed.discard()
+
+
 def test_journal_requires_continuous_scheduler(tmp_path, runner):
     from introspective_awareness_tpu.protocol.trials import run_grid_pass
 
@@ -313,6 +358,32 @@ def test_pool_consumes_injected_judge_outage_in_order():
     assert [d["error"] for d in stats["degraded"]] == [
         "InjectedJudgeTimeout", "InjectedJudgeServerError",
     ]
+
+
+def test_posthoc_outage_across_cells_defers_every_cell(tmp_path):
+    """A judge outage spanning the post-hoc grading of several cells must
+    journal one deferral PER CELL — a shared key would last-write-wins down
+    to only the final failed cell being re-graded on resume."""
+    from types import SimpleNamespace
+
+    from introspective_awareness_tpu.cli.sweep import _cell_metrics
+
+    journal = TrialJournal(tmp_path / "j.jsonl", {"x": 1})
+    args = SimpleNamespace(
+        _journal=journal, _judge_breaker=None, _ledger=None,
+        temperature=0.0, max_tokens=8,
+    )
+    judge = LLMJudge(client=DownClient())
+    cells = [(0.25, 2.0), (0.25, 8.0), (0.75, 2.0)]
+    for lf, s in cells:
+        results = [dict(r, detected=True) for r in _trial_results(2)]
+        metrics = _cell_metrics(results, judge, args, lf, 1, s)
+        assert metrics["metrics_source"] == "keyword"
+    assert journal.deferred_cells() == set(cells)
+    journal.close()
+    j2 = TrialJournal(tmp_path / "j.jsonl", {"x": 1})
+    assert j2.deferred_cells() == set(cells)
+    j2.close()
 
 
 def test_circuit_breaker_transitions(monkeypatch):

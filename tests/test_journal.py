@@ -149,6 +149,32 @@ def test_duplicate_records_last_write_wins(tmp_path):
     j2.close()
 
 
+def test_string_identity_keys_roundtrip(tmp_path):
+    # The protocol layer keys records by trial-identity strings; they must
+    # survive the JSON round-trip unchanged (no int coercion).
+    j = _mk(tmp_path)
+    key = "Dust|3|0.25|8.0"
+    j.record_decoded("fused/injection", key, {"response": "a"})
+    j.record_graded("fused/injection", key, {"v": 1})
+    j.close()
+    j2 = _mk(tmp_path)
+    assert j2.decoded("fused/injection") == {key: {"response": "a"}}
+    assert j2.graded("fused/injection") == {key: {"v": 1}}
+    j2.close()
+
+
+def test_old_schema_journal_rejected(tmp_path):
+    # Schema 1 keyed records by queue index, which misattributes trials when
+    # the resumed task list is shorter — replaying it must be refused.
+    path = tmp_path / "trial_journal.jsonl"
+    path.write_bytes(
+        _frame({"ev": "start", "schema": 1, "config": CFG})
+        + _frame({"ev": "decoded", "pass": "p", "idx": 0, "result": {}})
+    )
+    with pytest.raises(JournalConfigMismatch, match="schema"):
+        _mk(tmp_path)
+
+
 def test_config_mismatch_rejected(tmp_path):
     j = _mk(tmp_path)
     j.record_decoded("p", 0, {"response": "a"})
@@ -220,7 +246,41 @@ def test_clean_stop_marker(tmp_path):
     j.record_clean_stop()
     j.close()
     j2 = _mk(tmp_path)
+    assert j2.was_clean_stop and j2.gauges.clean_stop
+    j2.close()
+
+
+def test_clean_stop_superseded_by_later_records(tmp_path):
+    # The marker only counts as the FINAL record: a resumed run that appends
+    # more records then crashes hard must not replay as a clean stop.
+    j = _mk(tmp_path)
+    j.record_decoded("p", 0, {"response": "a"})
+    j.record_clean_stop()
+    j.close()
+    j2 = _mk(tmp_path)
     assert j2.was_clean_stop
+    j2.record_decoded("p", 1, {"response": "b"})  # resume, then hard crash
+    j2.close()
+    j3 = _mk(tmp_path)
+    assert not j3.was_clean_stop and not j3.gauges.clean_stop
+    j3.close()
+
+
+def test_posthoc_deferrals_keyed_per_cell_do_not_collide(tmp_path):
+    # Deferral replay is last-write-wins on (pass, key): a judge outage
+    # spanning several cells must key each deferral uniquely or only the
+    # last failed cell would ever be re-graded on resume.
+    j = _mk(tmp_path)
+    j.record_deferred("posthoc", "cell/0.25/2.0", "APIError: 503", 1,
+                      cell=(0.25, 2.0))
+    j.record_deferred("posthoc", "cell/0.75/8.0", "APIError: 503", 1,
+                      cell=(0.75, 8.0))
+    assert j.deferred_cells() == {(0.25, 2.0), (0.75, 8.0)}
+    j.close()
+    j2 = _mk(tmp_path)
+    assert j2.deferred_cells() == {(0.25, 2.0), (0.75, 8.0)}
+    j2.record_cell_regraded((0.25, 2.0))
+    assert j2.deferred_cells() == {(0.75, 8.0)}
     j2.close()
 
 
