@@ -21,6 +21,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import time
@@ -30,6 +31,46 @@ import numpy as np
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def _gated(name: str, fn, ledger) -> dict:
+    """Run one bench section behind the HBM gate.
+
+    A config that fails the AOT preflight (HbmPreflightError, carrying the
+    offending buffer names) or dies in a real device OOM
+    (RESOURCE_EXHAUSTED) becomes a ``{"skipped": True, "reason",
+    "top_temps"}`` section plus a ``preflight_skip`` ledger event; any other
+    exception still propagates. The bench therefore cannot exit non-zero
+    because one configuration was too big for the chip — the r05 failure
+    mode (rc=1 mid-sweep, every later section lost).
+    """
+    from introspective_awareness_tpu import obs
+
+    try:
+        return fn()
+    except obs.HbmPreflightError as e:
+        rep = e.report
+        attrs = obs.preflight_skip(
+            ledger, label=name, reason="hbm_preflight_over_budget", report=rep
+        )
+        log(f"  [{name}] SKIPPED (preflight): {rep.message()}")
+        return {
+            "skipped": True, "section": name, "reason": attrs["reason"],
+            "top_temps": attrs.get("top_temps", []),
+            "total_bytes": rep.total_bytes, "budget_bytes": rep.budget_bytes,
+        }
+    except Exception as e:  # noqa: BLE001 — only the OOM class is absorbed
+        msg = f"{type(e).__name__}: {e}"
+        if "RESOURCE_EXHAUSTED" not in msg and "out of memory" not in msg.lower():
+            raise
+        attrs = obs.preflight_skip(
+            ledger, label=name, reason=f"device_oom: {msg[:300]}"
+        )
+        log(f"  [{name}] SKIPPED (device OOM): {msg[:200]}")
+        return {
+            "skipped": True, "section": name, "reason": attrs["reason"],
+            "top_temps": [],
+        }
 
 
 # Peak HBM bandwidth by device kind (GB/s); None → utilization not reported.
@@ -669,20 +710,201 @@ def _durability_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     return r
 
 
-def _hbm_model(runner, cfg, batch, prompt_len, max_new) -> float:
-    """Modeled HBM bytes read per decode step: every parameter once + the
-    full KV-cache buffer (the decode attention reads all T slots each step
-    regardless of validity)."""
+def _hbm_model(runner, cfg, batch, prompt_len, max_new,
+               batch_chunk=None, suffix_chunk=None) -> dict:
+    """Modeled HBM bytes for the best config, chunk-plan aware.
+
+    ``decode_bytes_per_step``: every parameter once + the full KV-cache
+    buffer (the decode attention reads all T slots each step regardless of
+    validity) — unchanged by prefill chunking, which only reshapes how the
+    cache gets FILLED. ``peak_prefill_bytes`` follows the actual chunk plan
+    (runtime.generate.prefill_plan): attention activations scale with the
+    [rows, cols] block in flight, not the monolithic [B, S] rectangle, plus
+    one per-block staging cache when the blocked path is active.
+    """
     import jax
+
+    from introspective_awareness_tpu.runtime.generate import prefill_plan
 
     weight_bytes = sum(x.nbytes for x in jax.tree.leaves(runner.params))
     T = prompt_len + max_new
-    kv_bytes = (
-        cfg.n_layers * batch * T * cfg.cache_kv_heads
-        * (cfg.cache_k_dim + (0 if cfg.is_mla else cfg.head_dim))
-        * (1 if cfg.kv_cache_dtype == "fp8" else 2)
+    kv_elem = cfg.cache_kv_heads * (
+        cfg.cache_k_dim + (0 if cfg.is_mla else cfg.head_dim)
     )
-    return float(weight_bytes + kv_bytes)
+    kv_byte = 1 if cfg.kv_cache_dtype == "fp8" else 2
+    kv_bytes = cfg.n_layers * batch * T * kv_elem * kv_byte
+
+    plan = prefill_plan(batch, prompt_len, batch_chunk, suffix_chunk)
+    act_byte = 2  # bf16 activations on the bench model
+    # ~6 live [rows, cols, NH, D] arrays per suffix pass (q/k/v rotated +
+    # probs + attn out) — the r05 temp class that chunking bounds.
+    act_bytes = (
+        6 * plan.block_batch * plan.sub_width * cfg.n_heads * cfg.head_dim
+        * act_byte
+    )
+    chunked = batch_chunk is not None or suffix_chunk is not None
+    block_cache = (
+        cfg.n_layers * plan.block_batch * T * kv_elem * kv_byte
+        if chunked else 0
+    )
+    return {
+        "decode_bytes_per_step": float(weight_bytes + kv_bytes),
+        "peak_prefill_bytes": float(
+            weight_bytes + kv_bytes + block_cache + act_bytes
+        ),
+        "prefill_plan": {
+            "batch_chunk": batch_chunk, "suffix_chunk": suffix_chunk,
+            "blocks": len(plan.blocks), "subs": len(plan.subs),
+            "block_batch": plan.block_batch, "sub_width": plan.sub_width,
+        },
+    }
+
+
+def _prefill_memory(runner, cfg, eq_batch, big_batch, max_new, ledger,
+                    budget_frac) -> dict:
+    """Chunked vs monolithic large-batch prefill: equivalence + memory.
+
+    Three parts. (1) Bit-identity: ``generate_tokens_prefix`` with
+    batch/suffix chunking vs the monolithic path, greedy AND sampled, on a
+    ragged left-padded shared-prefix workload with active steering —
+    chunking must be a pure memory optimization. (2) AOT memory analysis at
+    the r05 failing shape class (``big_batch`` rows): lower+compile both
+    variants with ``max_new_tokens=1`` (prefill-only program, no decode
+    loop) and compare temp bytes plus full-batch rank-4 HLO offender counts
+    (``obs.scan_hlo_temps``) — the broadcast temp class that killed the r05
+    batch-256 run. (3) The chunk-plan autotuner decision at ``big_batch``
+    under ``--hbm-budget-frac``, recorded here and in the run ledger.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from introspective_awareness_tpu import obs
+    from introspective_awareness_tpu.runtime.generate import (
+        GenSpec,
+        generate_tokens_prefix,
+    )
+
+    rng = np.random.default_rng(11)
+    vmax = min(cfg.vocab_size, 200)
+    B, P0, Ss = eq_batch, 48, 32
+    prefix = jnp.asarray(rng.integers(1, vmax, size=(P0,)), jnp.int32)
+    sfx = rng.integers(1, vmax, size=(B, Ss)).astype(np.int32)
+    msk = np.ones((B, Ss), np.int32)
+    for b in range(B):  # ragged rows, LEFT-padded like the runner produces
+        msk[b, : (b % 4) * 3] = 0
+    sfx *= msk
+    vecs = jnp.asarray(rng.normal(size=(B, cfg.hidden_size)), jnp.float32)
+    starts = jnp.asarray(rng.integers(0, Ss, size=(B,)), jnp.int32)
+    max_new_eq = min(max_new, 16)
+
+    def gen(temp, bc, sc):
+        spec = GenSpec(
+            rng=jax.random.key(3), temperature=jnp.float32(temp),
+            steer_layer=jnp.int32(int(cfg.n_layers * 0.6)),
+            steer_strength=jnp.float32(4.0), steer_vectors=vecs,
+            steer_start=starts, eos_ids=jnp.asarray([vmax + 7], jnp.int32),
+            pad_id=jnp.int32(0),
+        )
+        # Fresh host copies per call: the suffix operands are donated.
+        return np.asarray(generate_tokens_prefix(
+            runner.params, cfg, prefix, sfx.copy(), msk.copy(), spec,
+            max_new_tokens=max_new_eq, batch_chunk=bc, suffix_chunk=sc,
+        ))
+
+    plans = [(max(1, B // 2), max(1, Ss // 2)), (max(1, B // 4), None)]
+    identical = True
+    for temp in (0.0, 1.0):
+        ref = gen(temp, None, None)
+        for bc, sc in plans:
+            identical = identical and bool(np.array_equal(ref, gen(temp, bc, sc)))
+
+    # AOT comparison at the big-batch shape: abstract operands, prefill-only
+    # program (max_new_tokens=1 drops the decode while_loop, so the scan sees
+    # exactly the prefill temps the r05 run died on).
+    Pb, Sb = 128, 256
+    sds = jax.ShapeDtypeStruct
+    spec_a = GenSpec(
+        rng=sds((), jax.random.key(0).dtype),
+        temperature=sds((), jnp.float32), steer_layer=sds((), jnp.int32),
+        steer_strength=sds((), jnp.float32),
+        steer_vectors=sds((big_batch, cfg.hidden_size), jnp.float32),
+        steer_start=sds((big_batch,), jnp.int32),
+        eos_ids=sds((1,), jnp.int32), pad_id=sds((), jnp.int32),
+    )
+
+    def lower(bc, sc):
+        return generate_tokens_prefix.lower(
+            runner.params, cfg, sds((Pb,), jnp.int32),
+            sds((big_batch, Sb), jnp.int32), sds((big_batch, Sb), jnp.int32),
+            spec_a, max_new_tokens=1, batch_chunk=bc, suffix_chunk=sc,
+        ).compile()
+
+    def temp_bytes(compiled):
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        return int(getattr(ma, "temp_size_in_bytes", 0))
+
+    def offenders(compiled):
+        # Full-batch-leading rank-4 temps with real padding expansion — the
+        # broadcast class. Per-block chunked temps lead with rows < B and
+        # never match; entry_only because a prefill-only program has no
+        # while body, so only ENTRY-level values own buffers.
+        return obs.scan_hlo_temps(
+            compiled.as_text(), min_bytes=1024 * 1024, min_expansion=1.5,
+            rank=4, min_leading_dim=big_batch, entry_only=True,
+        )
+
+    mono = lower(None, None)
+    chunk_bc = max(1, big_batch // 4)
+    chk = lower(chunk_bc, None)
+    tm, tc = temp_bytes(mono), temp_bytes(chk)
+    om, oc = offenders(mono), offenders(chk)
+
+    # Autotune decision at the big-batch shape, recorded in the run ledger
+    # (autotune_decision / preflight_skip events) and in this section.
+    cands = [(None, None)]
+    bc = big_batch
+    while bc > max(1, big_batch // 8):
+        bc //= 2
+        cands.append((bc, None))
+    try:
+        decision = obs.autotune(
+            cands, lambda c: lower(*c), label=f"prefill[b{big_batch}]",
+            budget_frac=budget_frac, ledger=ledger,
+        ).as_dict()
+    except obs.HbmPreflightError as e:
+        decision = {"chosen": None, "error": e.report.message()}
+
+    r = {
+        "eq_batch": B,
+        "outputs_identical": identical,
+        "chunk_plans_checked": [list(p) for p in plans],
+        "aot": {
+            "big_batch": big_batch, "shape": [big_batch, Pb + Sb],
+            "monolithic": {
+                "temp_bytes": tm, "fullbatch_rank4_offenders": len(om),
+                "top": om[:3],
+            },
+            "chunked": {
+                "batch_chunk": chunk_bc, "temp_bytes": tc,
+                "fullbatch_rank4_offenders": len(oc),
+            },
+            "temp_reduction": (
+                round(tm / tc, 2) if tm and tc else None
+            ),
+        },
+        "autotune": decision,
+    }
+    log(
+        f"  [prefill_memory] identical={identical} (b={B}, greedy+sampled); "
+        f"AOT b={big_batch}: mono {len(om)} offenders"
+        f"/{tm and tm >> 20 or '?'}MiB temps vs chunked(bc={chunk_bc}) "
+        f"{len(oc)} offenders/{tc and tc >> 20 or '?'}MiB "
+        f"-> {r['aot']['temp_reduction']}x; autotune chose "
+        f"{decision.get('chosen')}"
+    )
+    return r
 
 
 def main() -> None:
@@ -690,6 +912,22 @@ def main() -> None:
 
     from introspective_awareness_tpu import obs
     from introspective_awareness_tpu.utils import enable_compilation_cache
+
+    ap = argparse.ArgumentParser(description="introspection eval throughput bench")
+    ap.add_argument(
+        "--hbm-budget-frac", type=float, default=0.9,
+        help="fraction of device HBM the AOT preflight may plan for; "
+        "configs over budget become skipped sections, never a crashed bench",
+    )
+    ap.add_argument(
+        "--prefill-batch-chunk", type=int, default=None,
+        help="force a prefill batch chunk (default: autotuned under budget)",
+    )
+    ap.add_argument(
+        "--prefill-suffix-chunk", type=int, default=None,
+        help="force a prefill suffix chunk (default: autotuned under budget)",
+    )
+    args = ap.parse_args()
 
     # Warm restarts skip the ~7 config compiles (~4 min of the bench's
     # wall-clock); cold runs are unaffected beyond cache writes.
@@ -751,7 +989,10 @@ def main() -> None:
         f"in {time.perf_counter()-t0:.1f}s")
 
     runner = ModelRunner(
-        params, cfg, tok, model_name="bench-llama1b-shape", ledger=ledger
+        params, cfg, tok, model_name="bench-llama1b-shape", ledger=ledger,
+        hbm_budget_frac=args.hbm_budget_frac,
+        prefill_batch_chunk=args.prefill_batch_chunk,
+        prefill_suffix_chunk=args.prefill_suffix_chunk,
     )
 
     # Honest output check: token-id statistics from one token-level run
@@ -776,53 +1017,106 @@ def main() -> None:
         raise SystemExit(1)
 
     # ---- batch sweep, bf16 -------------------------------------------------
-    results = [
-        _timed_config(runner, cfg, tok, b, max_new, iters, "bf16")
-        for b in batches
-    ]
+    # Every section and sweep row runs behind the HBM gate: an over-budget
+    # or OOM config is recorded as a skipped row with the offending buffers,
+    # and the bench carries on (r05 lost the whole document to one config).
+    results = []
+    for b in batches:
+        row = _gated(
+            f"bf16[b{b}]",
+            lambda b=b: _timed_config(runner, cfg, tok, b, max_new, iters,
+                                      "bf16"),
+            ledger,
+        )
+        row.setdefault("label", "bf16")
+        row.setdefault("batch", b)
+        results.append(row)
 
     # ---- continuous scheduler vs fixed batches on a mixed-budget queue -----
-    sched = _sched_compare(runner, cfg, tok, batches[0], max_new, ledger)
+    sched = _gated(
+        "scheduler",
+        lambda: _sched_compare(runner, cfg, tok, batches[0], max_new, ledger),
+        ledger,
+    )
 
     # ---- pipelined vs synchronous host loop + grading overlap --------------
-    pipe = _pipeline_compare(runner, cfg, tok, batches[0], max_new, ledger)
+    pipe = _gated(
+        "pipeline",
+        lambda: _pipeline_compare(runner, cfg, tok, batches[0], max_new,
+                                  ledger),
+        ledger,
+    )
 
     # ---- staged admission vs synchronous refill (churny queue) -------------
-    stg = _staged_compare(runner, cfg, tok, batches[0], max_new, ledger)
+    stg = _gated(
+        "staged_prefill",
+        lambda: _staged_compare(runner, cfg, tok, batches[0], max_new, ledger),
+        ledger,
+    )
 
     # ---- crash + torn tail + resume through the trial journal --------------
-    dur = _durability_compare(runner, cfg, tok, batches[0], max_new, ledger)
+    dur = _gated(
+        "durability",
+        lambda: _durability_compare(runner, cfg, tok, batches[0], max_new,
+                                    ledger),
+        ledger,
+    )
+
+    # ---- chunked large-batch prefill: equivalence + AOT memory + autotune --
+    pmem = _gated(
+        "prefill_memory",
+        lambda: _prefill_memory(
+            runner, cfg, 32 if on_tpu else batches[0], 256, max_new, ledger,
+            args.hbm_budget_frac,
+        ),
+        ledger,
+    )
 
     # ---- int8 weight-quantized variant at the best bf16 batch --------------
-    if on_tpu:
+    bf16_ok = [r for r in results if not r.get("skipped")]
+    if on_tpu and bf16_ok:
         import dataclasses
 
-        best_bf16 = max(results, key=lambda r: r["evals_per_sec_chip"])
+        best_bf16 = max(bf16_ok, key=lambda r: r["evals_per_sec_chip"])
         # include_embed: the tied LM head is the single largest weight read
         # of a decode step (0.5 GB bf16 at Llama-3 vocab).
         q_params = quantize_params(params, bits=8, dtype=dtype, include_embed=True)
         q_runner = ModelRunner(
             q_params, cfg, tok, model_name="bench-llama1b-int8",
-            ledger=ledger,
+            ledger=ledger, hbm_budget_frac=args.hbm_budget_frac,
+            prefill_batch_chunk=args.prefill_batch_chunk,
+            prefill_suffix_chunk=args.prefill_suffix_chunk,
         )
-        results.append(
-            _timed_config(
+        row = _gated(
+            f"int8[b{best_bf16['batch']}]",
+            lambda: _timed_config(
                 q_runner, cfg, tok, best_bf16["batch"], max_new, iters, "int8"
-            )
+            ),
+            ledger,
         )
+        row.setdefault("label", "int8")
+        row.setdefault("batch", best_bf16["batch"])
+        results.append(row)
 
         # ---- + fp8 KV cache: halves the dominant decode HBM stream ---------
         cfg8 = dataclasses.replace(cfg, kv_cache_dtype="fp8")
         kv_runner = ModelRunner(
             q_params, cfg8, tok, model_name="bench-llama1b-int8-fp8kv",
-            ledger=ledger,
+            ledger=ledger, hbm_budget_frac=args.hbm_budget_frac,
+            prefill_batch_chunk=args.prefill_batch_chunk,
+            prefill_suffix_chunk=args.prefill_suffix_chunk,
         )
-        results.append(
-            _timed_config(
+        row = _gated(
+            f"int8+fp8kv[b{best_bf16['batch']}]",
+            lambda: _timed_config(
                 kv_runner, cfg8, tok, best_bf16["batch"], max_new, iters,
                 "int8+fp8kv",
-            )
+            ),
+            ledger,
         )
+        row.setdefault("label", "int8+fp8kv")
+        row.setdefault("batch", best_bf16["batch"])
+        results.append(row)
 
     # ---- on-device judge interleaving cost ---------------------------------
     # The BASELINE "no API in the loop" config co-locates a grader model on
@@ -831,121 +1125,148 @@ def main() -> None:
     # only triggers for claimers, so this is the steady-state floor). Both
     # models run the fast-path config: int8 weights (+embed) and fp8 KV;
     # the grader stops at "Answer: YES|NO" (GenSpec.stop_seqs).
-    if on_tpu:
+    if on_tpu and bf16_ok:
         from introspective_awareness_tpu.judge import LLMJudge, OnDeviceJudgeClient
         from introspective_awareness_tpu.judge.judge import reconstruct_trial_prompts
 
-        # A second, independently-initialized parameter set: co-residency
-        # means BOTH models' weights live in HBM at once.
-        grader_params = quantize_params(
-            init(cfg, jax.random.key(1), dtype=dtype), bits=8, dtype=dtype,
-            include_embed=True,
-        )
-        grader = ModelRunner(
-            grader_params, cfg8, tok, model_name="bench-grader-1b-int8-fp8kv",
-            ledger=ledger,
-        )
-
-        # The grader runs the FULL verbatim criteria with the prefix-cached
-        # prompt order (criteria.render): the ~1800-token criteria text is a
-        # shared prefix prefilled once per grading chunk, and the suffix
-        # chunk attends through the fused flash path. Grading chunks stay at
-        # 96: the grader's 2048-slot fp8 cache at larger batches pushes the
-        # co-resident pair into XLA rematerialization (~10x slowdown).
-        judge = LLMJudge(
-            client=OnDeviceJudgeClient(grader, max_tokens=48, chunk_size=96)
-        )
-        judge.ledger = ledger
-        b = min(192, best_bf16["batch"])
-        prompts, vecs, starts = _build_workload(cfg, tok, b)
-        judge_phase = [0.0]
-
-        def run_with_grading(seed):
-            responses = kv_runner.generate_batch_with_multi_steering(
-                prompts, layer_idx=int(cfg.n_layers * 0.6),
-                steering_vectors=list(vecs), strength=4.0,
-                max_new_tokens=max_new, temperature=1.0,
-                steering_start_positions=starts, seed=seed,
+        def _judge_section():
+            # A second, independently-initialized parameter set: co-residency
+            # means BOTH models' weights live in HBM at once. Living inside
+            # this closure, the grader weights are freed when it returns —
+            # the large-batch section below needs the HBM back.
+            grader_params = quantize_params(
+                init(cfg, jax.random.key(1), dtype=dtype), bits=8, dtype=dtype,
+                include_embed=True,
             )
-            rs = [
-                {"concept": "bench", "response": r, "trial": i + 1,
-                 "trial_type": "injection"}
-                for i, r in enumerate(responses)
-            ]
-            tj = time.perf_counter()
-            graded = judge.evaluate_batch(rs, reconstruct_trial_prompts(rs))
-            judge_phase[0] += time.perf_counter() - tj
-            return graded
+            grader = ModelRunner(
+                grader_params, cfg8, tok,
+                model_name="bench-grader-1b-int8-fp8kv", ledger=ledger,
+                hbm_budget_frac=args.hbm_budget_frac,
+            )
 
-        t0 = time.perf_counter()
-        run_with_grading(0)
-        warm = time.perf_counter() - t0
-        judge_phase[0] = 0.0
-        t0 = time.perf_counter()
-        for i in range(2):
-            run_with_grading(i + 1)
-        dt = time.perf_counter() - t0
-        judged_rate = 2 * b / dt / jax.device_count()
-        log(
-            f"  [int8+fp8kv+judge] batch={b}: "
-            f"{judged_rate:.1f} graded evals/s/chip (warmup {warm:.1f}s, "
-            f"grading {judge_phase[0]:.1f}s of {dt:.1f}s) — generation + "
-            "stage-1 claims grading by a co-resident same-size int8 grader"
-        )
-        results.append({
-            "label": "int8+fp8kv+judge", "batch": b,
-            "evals_per_sec_chip": judged_rate,
-            # This row's unit is GRADED evals: generation AND stage-1
-            # grading both complete. Generation throughput for the same
-            # config is the plain int8+fp8kv row; report the judge phase
-            # split instead of a misleading 0.0 tok/s.
-            "judge_phase_s": round(judge_phase[0], 2),
-            "gen_phase_s": round(dt - judge_phase[0], 2),
-            "warmup_s": round(warm, 2), "timed_s": round(dt, 2),
-        })
+            # The grader runs the FULL verbatim criteria with the
+            # prefix-cached prompt order (criteria.render): the ~1800-token
+            # criteria text is a shared prefix prefilled once per grading
+            # chunk, and the suffix chunk attends through the fused flash
+            # path. Grading chunks stay at 96: the grader's 2048-slot fp8
+            # cache at larger batches pushes the co-resident pair into XLA
+            # rematerialization (~10x slowdown).
+            judge = LLMJudge(
+                client=OnDeviceJudgeClient(grader, max_tokens=48, chunk_size=96)
+            )
+            judge.ledger = ledger
+            b = min(192, best_bf16["batch"])
+            prompts, vecs, starts = _build_workload(cfg, tok, b)
+            judge_phase = [0.0]
+
+            def run_with_grading(seed):
+                responses = kv_runner.generate_batch_with_multi_steering(
+                    prompts, layer_idx=int(cfg.n_layers * 0.6),
+                    steering_vectors=list(vecs), strength=4.0,
+                    max_new_tokens=max_new, temperature=1.0,
+                    steering_start_positions=starts, seed=seed,
+                )
+                rs = [
+                    {"concept": "bench", "response": r, "trial": i + 1,
+                     "trial_type": "injection"}
+                    for i, r in enumerate(responses)
+                ]
+                tj = time.perf_counter()
+                graded = judge.evaluate_batch(rs, reconstruct_trial_prompts(rs))
+                judge_phase[0] += time.perf_counter() - tj
+                return graded
+
+            t0 = time.perf_counter()
+            run_with_grading(0)
+            warm = time.perf_counter() - t0
+            judge_phase[0] = 0.0
+            t0 = time.perf_counter()
+            for i in range(2):
+                run_with_grading(i + 1)
+            dt = time.perf_counter() - t0
+            judged_rate = 2 * b / dt / jax.device_count()
+            log(
+                f"  [int8+fp8kv+judge] batch={b}: "
+                f"{judged_rate:.1f} graded evals/s/chip (warmup {warm:.1f}s, "
+                f"grading {judge_phase[0]:.1f}s of {dt:.1f}s) — generation + "
+                "stage-1 claims grading by a co-resident same-size int8 grader"
+            )
+            return {
+                "label": "int8+fp8kv+judge", "batch": b,
+                "evals_per_sec_chip": judged_rate,
+                # This row's unit is GRADED evals: generation AND stage-1
+                # grading both complete. Generation throughput for the same
+                # config is the plain int8+fp8kv row; report the judge phase
+                # split instead of a misleading 0.0 tok/s.
+                "judge_phase_s": round(judge_phase[0], 2),
+                "gen_phase_s": round(dt - judge_phase[0], 2),
+                "warmup_s": round(warm, 2), "timed_s": round(dt, 2),
+            }
+
+        row = _gated("judge", _judge_section, ledger)
+        row.setdefault("label", "int8+fp8kv+judge")
+        results.append(row)
 
     # ---- largest batch the halved (fp8) cache can fit ----------------------
     # Runs LAST: an OOM here must not starve the other configs of HBM.
     # 1.5x fits on v5e (16 GB); 2x does not (measured), so don't burn a
     # compile attempt on it every run.
-    if on_tpu:
+    if on_tpu and bf16_ok:
         import gc
 
-        del grader, grader_params, judge
         gc.collect()
         big = 3 * best_bf16["batch"] // 2
-        try:
-            results.append(
-                _timed_config(
-                    kv_runner, cfg8, tok, big, max_new, iters, "int8+fp8kv"
-                )
-            )
-        except Exception as e:  # noqa: BLE001 - memory-dependent extra point
-            log(f"  [int8+fp8kv] batch={big}: skipped ({type(e).__name__})")
-            gc.collect()
+        row = _gated(
+            f"int8+fp8kv[b{big}]",
+            lambda: _timed_config(
+                kv_runner, cfg8, tok, big, max_new, iters, "int8+fp8kv"
+            ),
+            ledger,
+        )
+        row.setdefault("label", "int8+fp8kv")
+        row.setdefault("batch", big)
+        results.append(row)
+        gc.collect()
 
     # Judge-graded throughput is a different workload; the headline metric
-    # stays pure generation.
-    best = max(
-        (r for r in results if "judge" not in r["label"]),
-        key=lambda r: r["evals_per_sec_chip"],
-    )
+    # stays pure generation. Skipped rows carry no throughput at all.
+    candidates = [
+        r for r in results
+        if not r.get("skipped") and "judge" not in r["label"]
+    ]
+    if candidates:
+        best = max(candidates, key=lambda r: r["evals_per_sec_chip"])
+    else:  # every config over budget — still emit a parseable document
+        best = {
+            "label": "none", "batch": None, "evals_per_sec_chip": 0.0,
+            "gen_tok_per_sec": 0.0, "decode_steps_per_sec": 0.0,
+        }
     prompt_len = stats["prompt_len"]
     peak = _peak_hbm_gbps()
     hbm_util = None
-    if peak and on_tpu:
+    hbm_model = None
+    if peak and on_tpu and candidates:
         best_runner = {
             "int8": q_runner, "int8+fp8kv": kv_runner
         }.get(best["label"], runner)
-        bytes_per_step = _hbm_model(
-            best_runner, best_runner.cfg, best["batch"], prompt_len, max_new
+        # Chunk accounting follows what actually ran: the autotuner's last
+        # winning (batch_chunk, suffix_chunk), or the forced CLI plan.
+        chosen = (best_runner.last_autotune or {}).get("chosen") or [
+            best_runner.prefill_batch_chunk, best_runner.prefill_suffix_chunk
+        ]
+        hbm_model = _hbm_model(
+            best_runner, best_runner.cfg, best["batch"], prompt_len, max_new,
+            batch_chunk=chosen[0], suffix_chunk=chosen[1],
         )
+        bytes_per_step = hbm_model["decode_bytes_per_step"]
         eff_gbps = bytes_per_step * best["decode_steps_per_sec"] / 1e9
         hbm_util = eff_gbps / peak
         log(
             f"modeled HBM traffic at best config: {bytes_per_step/1e9:.2f} GB/step "
             f"x {best['decode_steps_per_sec']:.0f} steps/s = {eff_gbps:.0f} GB/s "
-            f"({100 * hbm_util:.0f}% of {peak:.0f} GB/s peak)"
+            f"({100 * hbm_util:.0f}% of {peak:.0f} GB/s peak); "
+            f"peak prefill {hbm_model['peak_prefill_bytes']/1e9:.2f} GB "
+            f"under plan {hbm_model['prefill_plan']}"
         )
 
     # Live per-device HBM watermark (None off-TPU: CPU backends don't
@@ -982,8 +1303,12 @@ def main() -> None:
         "pipeline": pipe,
         "staged_prefill": stg,
         "durability": dur,
+        "prefill_memory": pmem,
         "phases": ledger.summary().get("phases", {}),
         "hbm_preflight": preflight_verdict,
+        "hbm_budget_frac": args.hbm_budget_frac,
+        "hbm_model": hbm_model,
+        "prefill_autotune": runner.last_autotune,
         "hbm_devices": hbm_devices,
         "compile_stats": acct.delta_since(compile_before),
         "n_chips": n_chips,

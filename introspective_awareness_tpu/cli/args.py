@@ -153,6 +153,16 @@ def build_parser() -> argparse.ArgumentParser:
                              "memory_analysis() footprint exceeds this "
                              "fraction of per-device HBM (e.g. 0.9), naming "
                              "the largest temp buffers. Default off.")
+    parser.add_argument("--prefill-batch-chunk", type=int, default=None,
+                        help="Route large-batch shared-prefix prefill "
+                             "through batch blocks of this many rows "
+                             "(bounds peak prefill HBM; outputs stay "
+                             "bit-identical). Default: monolithic, or "
+                             "autotuned under --hbm-budget-frac.")
+    parser.add_argument("--prefill-suffix-chunk", type=int, default=None,
+                        help="Also split the suffix into column chunks of "
+                             "this width during chunked prefill. Default: "
+                             "whole suffix per block.")
     parser.add_argument("--journal", type=str, default="auto",
                         help="Trial-level durability journal (crash-safe "
                              "resume at trial granularity, bit-identical to "

@@ -758,6 +758,11 @@ def _write_manifest(
         "ledger": runner.ledger.summary(),
         "ledger_path": getattr(runner.ledger, "path", None),
         "hbm_budget_frac": getattr(args, "hbm_budget_frac", None),
+        "prefill_chunks": [
+            getattr(args, "prefill_batch_chunk", None),
+            getattr(args, "prefill_suffix_chunk", None),
+        ],
+        "prefill_autotune": getattr(runner, "last_autotune", None),
         "judge": (
             None if judge is None else {
                 "backend": getattr(args, "judge_backend", None),
@@ -1029,6 +1034,10 @@ def main(argv: Optional[list[str]] = None) -> int:
                 runner = load_subject(model_name, args, mesh, rules)
             runner.ledger = ledger
             runner.hbm_budget_frac = args.hbm_budget_frac
+            runner.prefill_batch_chunk = getattr(
+                args, "prefill_batch_chunk", None)
+            runner.prefill_suffix_chunk = getattr(
+                args, "prefill_suffix_chunk", None)
             try:
                 with profile_trace(args.profile_dir):
                     all_results = run_sweep(args, runner, judge, model_name)

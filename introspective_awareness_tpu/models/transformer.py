@@ -1186,15 +1186,30 @@ def forward(
             attn = jnp.einsum("bsnr,rnd->bsnd", ctx, wv_b)  # [B,S,NH,VD]
             return attn, rk_full
         else:
-            # Prefill / extraction: per-head k,v for the current chunk only.
+            # Prefill / extraction: per-head k_nope/v for the current chunk,
+            # but the shared rope key stays RANK-DEFICIENT [B, T, NR] all the
+            # way into the score contraction. Broadcasting it to
+            # [B, S, NH, NR] (and concatenating into a per-head K) is the
+            # same math, but XLA materializes the per-head copies as padded
+            # HLO temps — at batch 256 that is the r05 OOM class
+            # (BENCH_r05.json: bf16 [B,S,NH,*] fusions, 2.0x tiling
+            # expansion). Splitting the score over the nope/rope components
+            # contracts the shared key once per token, never per head.
             kv = jnp.einsum("bsr,rq->bsq", c, W(lp["wkv_b"]))
             kv = kv.reshape(B, S, NH, ND + VD)
             k_nope, v = kv[..., :ND], kv[..., ND:]
-            k = jnp.concatenate(
-                [k_nope, jnp.broadcast_to(k_rot, (B, S, NH, NR))], -1
-            )
-            qq = jnp.concatenate([q_nope, q_rot], -1)
-            attn = _attention(qq, k, v, allowed, cfg)
+            s = (
+                jnp.einsum("bsnd,btnd->bnst", q_nope, k_nope,
+                           preferred_element_type=jnp.float32)
+                + jnp.einsum("bsnr,btr->bnst", q_rot, k_rot[:, :, 0, :],
+                             preferred_element_type=jnp.float32)
+            ) * scale
+            if cfg.attn_logit_softcap:
+                cap = cfg.attn_logit_softcap
+                s = cap * jnp.tanh(s / cap)
+            s = jnp.where(allowed[:, None, :, :], s, _NEG_INF)
+            probs = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bnst,btnd->bsnd", probs, v)
         return attn, row
 
     def block(h, xs, *, moe):

@@ -34,10 +34,15 @@ from introspective_awareness_tpu.obs.ledger import (
 from introspective_awareness_tpu.obs.pipeline import PipelineGauges, StagedGauges
 from introspective_awareness_tpu.obs.recovery import RecoveryGauges
 from introspective_awareness_tpu.obs.preflight import (
+    AutotuneResult,
     HbmPreflightError,
     PreflightReport,
+    autotune,
     device_hbm_bytes,
+    modeled_padded_bytes,
     preflight,
+    preflight_skip,
+    scan_hlo_temps,
     top_temp_buffers,
 )
 from introspective_awareness_tpu.obs.timing import (
@@ -49,6 +54,7 @@ from introspective_awareness_tpu.obs.timing import (
 )
 
 __all__ = [
+    "AutotuneResult",
     "CompileAccounting",
     "HbmPreflightError",
     "NullLedger",
@@ -60,12 +66,16 @@ __all__ = [
     "RunLedger",
     "Span",
     "Timings",
+    "autotune",
     "device_hbm_bytes",
     "enable_compilation_cache",
     "enable_debug_checks",
     "load_ledger",
+    "modeled_padded_bytes",
     "preflight",
+    "preflight_skip",
     "profile_trace",
+    "scan_hlo_temps",
     "timed",
     "top_temp_buffers",
 ]

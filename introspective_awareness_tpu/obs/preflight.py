@@ -134,6 +134,123 @@ def _shape_bytes(dtype: str, dims: str) -> Optional[int]:
     return n * nbytes
 
 
+def _parse_dims(dims: str) -> list[int]:
+    return [int(d) for d in dims.split(",") if d.strip()]
+
+
+def modeled_padded_bytes(dtype: str, dims: list) -> Optional[int]:
+    """TPU tiling model of the real allocation for an array shape.
+
+    XLA lays out TPU arrays in (sublane, lane) tiles: the minor dim is
+    padded to a multiple of 128 lanes and the second-minor to a multiple of
+    ``8 * max(1, 4 // itemsize)`` sublanes (f32: 8, bf16: 16, int8/fp8: 32).
+    A bf16 ``[B,S,8,64]`` activation therefore really occupies 4x its
+    nominal bytes — the r05 OOM multiplier. Returns None for unknown
+    dtypes; rank-0/1 shapes get lane padding only.
+    """
+    nbytes = _DTYPE_BYTES.get(dtype)
+    if nbytes is None:
+        return None
+    dims = list(dims)
+    if not dims:
+        return nbytes
+    pad = dims[:]
+    pad[-1] = -(-pad[-1] // 128) * 128
+    if len(pad) >= 2:
+        sub = 8 * max(1, 4 // nbytes)
+        pad[-2] = -(-pad[-2] // sub) * sub
+    n = 1
+    for d in pad:
+        n *= d
+    return n * nbytes
+
+
+# Opcodes whose result is (or aliases) an existing buffer rather than a
+# fresh materialization — not interesting as "temps".
+_VIEWISH_OPCODES = (
+    "parameter", "constant", "get-tuple-element", "tuple", "bitcast",
+    "copy", "copy-start", "copy-done",
+)
+
+
+def _entry_computation(hlo_text: str) -> str:
+    """The ENTRY computation's lines only. Instructions inside fusion /
+    helper computations are rewrite-internal values that never materialize a
+    buffer; counting them misattributes temps (a fused multiply inside a
+    fusion body is free, the fusion's OUTPUT is the allocation)."""
+    out: list[str] = []
+    depth, inside = 0, False
+    for line in hlo_text.splitlines():
+        if not inside and line.lstrip().startswith("ENTRY"):
+            inside = True
+        if inside:
+            out.append(line)
+            depth += line.count("{") - line.count("}")
+            if depth <= 0 and len(out) > 1:
+                break
+    return "\n".join(out)
+
+
+def scan_hlo_temps(
+    hlo_text: str,
+    *,
+    min_bytes: int = 64 * 1024**2,
+    min_expansion: float = 1.5,
+    rank: Optional[int] = None,
+    min_leading_dim: Optional[int] = None,
+    exclude_opcodes: tuple = _VIEWISH_OPCODES,
+    entry_only: bool = False,
+) -> list[dict[str, Any]]:
+    """Find HLO values whose modeled padded allocation crosses a threshold.
+
+    The r05 failure signature: materialized intermediates >= ``min_bytes``
+    whose TPU tiling padding expands them by more than ``min_expansion``
+    over their nominal size (bf16 ``[256,512,8,64]`` pays 4x). Pass
+    ``rank=`` / ``min_leading_dim=`` to target a shape class — the r05
+    offenders are full-batch-leading rank-4 ``[B,S,NH,D]`` attention
+    activations; the KV caches are layer-leading rank-5 state that
+    legitimately persists, and a chunked prefill's per-block temps are
+    bounded by ``batch_chunk < B`` in their leading dim (and sequenced, so
+    they never coexist). ``entry_only=True`` restricts the scan to the
+    ENTRY computation — the right mode for prefill-only programs (no while
+    body), where only ENTRY-level values own buffers. Returns ``{op,
+    opcode, shape, bytes, padded_bytes, expansion}`` rows sorted
+    largest-first.
+    """
+    if entry_only:
+        hlo_text = _entry_computation(hlo_text)
+    out: dict[str, dict[str, Any]] = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_INSTR.match(line)
+        if not m:
+            continue
+        name, dtype, dims_s, opcode = m.groups()
+        if opcode in exclude_opcodes:
+            continue
+        dims = _parse_dims(dims_s)
+        if rank is not None and len(dims) != rank:
+            continue
+        if min_leading_dim is not None and (
+                not dims or dims[0] < min_leading_dim):
+            continue
+        nominal = _shape_bytes(dtype, dims_s)
+        padded = modeled_padded_bytes(dtype, dims)
+        if nominal is None or padded is None or padded < min_bytes:
+            continue
+        expansion = padded / nominal if nominal else 1.0
+        if expansion <= min_expansion:
+            continue
+        prev = out.get(name)
+        if prev is None or padded > prev["padded_bytes"]:
+            out[name] = {
+                "op": name, "opcode": opcode,
+                "shape": f"{dtype}[{dims_s}]",
+                "bytes": nominal, "padded_bytes": padded,
+                "expansion": round(expansion, 3),
+            }
+    return sorted(out.values(), key=lambda r: -r["padded_bytes"])
+
+
 def top_temp_buffers(hlo_text: str, top_k: int = 8) -> list[dict[str, Any]]:
     """Scan optimized HLO text for the largest intermediate values.
 
@@ -232,3 +349,134 @@ def preflight(
     if not ok and enforce:
         raise HbmPreflightError(report)
     return report
+
+
+def preflight_skip(
+    ledger: Optional[Any],
+    *,
+    label: str,
+    reason: str,
+    report: Optional[PreflightReport] = None,
+    candidate: Any = None,
+) -> dict[str, Any]:
+    """Record a config rejected by the HBM gate or the autotuner.
+
+    Emits a ``preflight_skip`` event into the run ledger carrying the
+    offending buffer names (from the preflight report's
+    ``memory_analysis()`` / HLO scan), so skipped work is visible in
+    ``run_manifest.json`` rather than only on stderr. Returns the event
+    attrs so callers (bench sections, autotune) can embed the same record
+    in their own JSON."""
+    attrs: dict[str, Any] = {"label": label, "reason": reason}
+    if candidate is not None:
+        attrs["candidate"] = list(candidate) if isinstance(
+            candidate, tuple) else candidate
+    if report is not None:
+        attrs["total_bytes"] = report.total_bytes
+        attrs["budget_bytes"] = report.budget_bytes
+        attrs["top_temps"] = [
+            {"op": b.get("op"), "bytes": b.get("bytes"),
+             "shape": b.get("shape")}
+            for b in report.top_temp_buffers
+        ]
+    if ledger is not None:
+        ledger.event("preflight_skip", **attrs)
+    return attrs
+
+
+@dataclasses.dataclass
+class AutotuneResult:
+    """Outcome of an :func:`autotune` walk: the winning candidate, its
+    compiled executable + report, and every rejection along the way."""
+
+    label: str
+    chosen: Any
+    chosen_index: int
+    tried: int
+    compiled: Any
+    report: Optional[PreflightReport]
+    rejected: list[dict]
+
+    def as_dict(self) -> dict[str, Any]:
+        chosen = self.chosen
+        return {
+            "label": self.label,
+            "chosen": list(chosen) if isinstance(chosen, tuple) else chosen,
+            "chosen_index": self.chosen_index,
+            "tried": self.tried,
+            "rejected": self.rejected,
+            "total_bytes": self.report.total_bytes if self.report else None,
+            "budget_bytes": self.report.budget_bytes if self.report else None,
+        }
+
+
+def autotune(
+    candidates,
+    build,
+    *,
+    label: str = "autotune",
+    device: Optional[Any] = None,
+    hbm_bytes: Optional[int] = None,
+    budget_frac: float = 0.9,
+    ledger: Optional[Any] = None,
+    top_k: int = 8,
+) -> AutotuneResult:
+    """Walk candidate configs (largest/fastest first) to the first whose
+    AOT memory plan fits the HBM budget.
+
+    ``build(candidate)`` returns either a compiled executable (anything
+    with ``memory_analysis()``, e.g. ``jit(f).lower(...).compile()``) or a
+    ``CompiledMemoryStats``-style stats object (tests). Each rejection —
+    over-budget plan or failed build — emits a ``preflight_skip`` ledger
+    event; the winner emits ``autotune_decision``. Raises
+    :class:`HbmPreflightError` when no candidate fits, so callers can
+    record a skipped-with-reason section instead of dying mid-run."""
+    candidates = list(candidates)
+    if not candidates:
+        raise ValueError("autotune needs at least one candidate")
+    rejected: list[dict] = []
+    last_report: Optional[PreflightReport] = None
+    for i, cand in enumerate(candidates):
+        try:
+            built = build(cand)
+        except HbmPreflightError as e:  # build() may preflight internally
+            last_report = e.report
+            rejected.append(preflight_skip(
+                ledger, label=label, reason="over_budget",
+                report=e.report, candidate=cand))
+            continue
+        except Exception as e:  # e.g. RESOURCE_EXHAUSTED during compile
+            rejected.append(preflight_skip(
+                ledger, label=label,
+                reason=f"build_failed: {type(e).__name__}: {e}",
+                candidate=cand))
+            continue
+        compiled, stats = (
+            (built, None) if hasattr(built, "memory_analysis")
+            else (None, built)
+        )
+        report = preflight(
+            compiled, stats=stats, label=f"{label}{list(cand) if isinstance(cand, tuple) else [cand]}",
+            device=device, hbm_bytes=hbm_bytes, budget_frac=budget_frac,
+            top_k=top_k, enforce=False, ledger=ledger,
+        )
+        if report.ok:
+            result = AutotuneResult(
+                label=label, chosen=cand, chosen_index=i, tried=i + 1,
+                compiled=built, report=report, rejected=rejected,
+            )
+            if ledger is not None:
+                ledger.event("autotune_decision", **result.as_dict())
+            return result
+        last_report = report
+        rejected.append(preflight_skip(
+            ledger, label=label, reason="over_budget",
+            report=report, candidate=cand))
+    if last_report is None:
+        last_report = PreflightReport(
+            label=label, ok=False, argument_bytes=0, output_bytes=0,
+            temp_bytes=0, generated_code_bytes=0, total_bytes=0,
+            hbm_bytes=hbm_bytes, budget_frac=budget_frac, budget_bytes=None,
+            top_temp_buffers=[],
+        )
+    raise HbmPreflightError(last_report)

@@ -98,6 +98,45 @@ def _chunk_plan(max_new_tokens: int) -> tuple[int, int]:
     return n_chunks, ch
 
 
+def _split_spans(total: int, chunk: Optional[int]) -> tuple[tuple[int, int], ...]:
+    """Static (offset, width) spans covering [0, total). ``chunk`` of
+    None/0 or >= total keeps one monolithic span; otherwise spans are
+    ``chunk`` wide with a narrower final remainder."""
+    if not chunk or chunk >= total:
+        return ((0, total),)
+    return tuple((o, min(chunk, total - o)) for o in range(0, total, chunk))
+
+
+class PrefillPlan(NamedTuple):
+    """Static decomposition of a [B, Ss] suffix prefill into bucketed
+    pieces (the chunked-prefill counterpart of ``_chunk_plan``). Peak
+    prefill activation memory scales with ``block_batch x sub_width``
+    instead of the full ``B x Ss`` rectangle; bench's HBM model and the
+    autotuner both consume this plan rather than assuming one monolithic
+    prefill."""
+
+    blocks: tuple[tuple[int, int], ...]  # (row offset, rows) batch blocks
+    subs: tuple[tuple[int, int], ...]  # (col offset, cols) suffix sub-chunks
+    block_batch: int  # widest batch block (rows per prefill dispatch)
+    sub_width: int  # widest sub-chunk (the per-block ring width)
+
+
+def prefill_plan(
+    batch: int,
+    suffix_len: int,
+    batch_chunk: Optional[int] = None,
+    suffix_chunk: Optional[int] = None,
+) -> PrefillPlan:
+    blocks = _split_spans(batch, batch_chunk)
+    subs = _split_spans(suffix_len, suffix_chunk)
+    return PrefillPlan(
+        blocks=blocks,
+        subs=subs,
+        block_batch=max(w for _, w in blocks),
+        sub_width=max(w for _, w in subs),
+    )
+
+
 def _steer_specs(spec: GenSpec, mask: jax.Array) -> tuple[SteerSpec, SteerSpec]:
     """(prompt-phase, decode-phase) steering from the padded-coords spec."""
     B, S = mask.shape
@@ -280,9 +319,38 @@ def generate_tokens(
     )
 
 
+def _broadcast_prefix(cache, prefix_cache, cfg: ModelConfig, P0: int):
+    """Broadcast the batch-1 prefix KV into every row's slots [0, P0) and
+    mark them valid. Shared by the monolithic and blocked prefill paths
+    (the blocked path broadcasts per batch block, so the full [L, B, T]
+    broadcast rectangle never exists as a prefill temp)."""
+    L, B = cache.k.shape[:2]
+
+    def put_prefix(dst, src):
+        rows = jnp.broadcast_to(src[:, :1], (L, B) + src.shape[2:])
+        return lax.dynamic_update_slice(
+            dst, rows.astype(dst.dtype), (0, 0, 0, 0, 0)
+        )
+
+    return cache._replace(
+        k=put_prefix(cache.k, prefix_cache.k),
+        v=put_prefix(cache.v, prefix_cache.v) if cache.v.shape[-1] else cache.v,
+        slot_mask=cache.slot_mask.at[:, :P0].set(True),
+        positions=cache.positions.at[:, :P0].set(
+            jnp.arange(P0, dtype=jnp.int32)[None]
+        ),
+        length=jnp.int32(P0),
+    )
+
+
+def _slice_rows(a, b0: int, bc: int):
+    """Batch-slice a steering operand that may be scalar or [B]-leading."""
+    return a[b0:b0 + bc] if getattr(a, "ndim", 0) >= 1 else a
+
+
 @partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens"),
+    static_argnames=("cfg", "max_new_tokens", "batch_chunk", "suffix_chunk"),
     donate_argnames=("suffix_ids", "suffix_mask"),
 )
 def generate_tokens_prefix(
@@ -294,6 +362,8 @@ def generate_tokens_prefix(
     spec: GenSpec,  # steer_start in PADDED SUFFIX coords
     *,
     max_new_tokens: int,
+    batch_chunk: Optional[int] = None,
+    suffix_chunk: Optional[int] = None,
 ) -> jax.Array:
     """``generate_tokens`` with shared-prefix KV caching.
 
@@ -309,6 +379,17 @@ def generate_tokens_prefix(
     occupy slots [0, P0) with positions 0..P0-1 for every row, the suffix is
     a ring continuation chunk (left-padded; pad slots stay invalid via
     ``rvalid``), and decode proceeds as usual.
+
+    ``batch_chunk`` / ``suffix_chunk`` (static) bound peak prefill HBM for
+    large batches: the suffix pass runs per [batch_chunk, suffix_chunk]
+    block against a block-sized prefix broadcast (the staged-prefill
+    bucketing idea applied inside one executable), each block's slots
+    written into the decode cache and chained through an
+    ``optimization_barrier`` so XLA cannot co-schedule two blocks' temps.
+    Sampling sees the same concatenated [B, V] first-token logits and the
+    same decode cache, so outputs are bit-identical to the monolithic path
+    (asserted by tests/test_prefill_chunking.py and bench's
+    ``prefill_memory`` section). Defaults (None) keep the monolithic trace.
     """
     B, Ss = suffix_ids.shape
     P0 = prefix_ids.shape[0]
@@ -325,65 +406,115 @@ def generate_tokens_prefix(
     )
 
     n_chunks, ch = _chunk_plan(max_new_tokens)
-    # The suffix chunk needs an Ss-slot ring; decode then swaps in a fresh
-    # whole-generation ring (below, never merged — see RING_CHUNK).
     T = P0 + Ss
-    cache = init_cache(cfg, B, T, dtype, ring_len=Ss)
-
-    # 2) Broadcast the prefix KV into every row's slots [0, P0).
-    def put_prefix(dst, src):
-        rows = jnp.broadcast_to(src[:, :1], (L, B) + src.shape[2:])
-        return lax.dynamic_update_slice(
-            dst, rows.astype(dst.dtype), (0, 0, 0, 0, 0)
-        )
-
-    cache = cache._replace(
-        k=put_prefix(cache.k, r0.cache.k),
-        v=put_prefix(cache.v, r0.cache.v) if cache.v.shape[-1] else cache.v,
-        slot_mask=cache.slot_mask.at[:, :P0].set(True),
-        positions=cache.positions.at[:, :P0].set(
-            jnp.arange(P0, dtype=jnp.int32)[None]
-        ),
-        length=jnp.int32(P0),
-    )
-    # Materialize the broadcast cache ONCE. Without the barrier XLA remats
-    # the fused broadcast_in_dim into every per-layer ``cache.k[l]`` slice of
-    # the decode loop, allocating ~n_layers simultaneous full-cache temps in
-    # a padded layout (2.0x at head_dim 64) — the round-5 bench
-    # RESOURCE_EXHAUSTED (BENCH_r05.json, transformer.py squeeze temps).
-    cache = lax.optimization_barrier(cache)
-
-    # 3) Per-row suffixes as one steered continuation chunk (ring path).
     steer_prompt, steer_decode = _steer_specs(spec, suffix_mask)
     suffix_pos = P0 + make_positions(suffix_mask)
-    r = forward(
-        params, cfg, suffix_ids, suffix_mask, suffix_pos,
-        cache=cache, steer=steer_prompt, use_cache=True, logits_mode="last",
-    )
-    cache = merge_ring(r.cache, cfg)
-    # Swap the (suffix-sized) ring for fresh decode tiers: the suffix rows
-    # now live in the main slots; decode starts from an all-invalid chunk
-    # ring (+ merged buffer, unless the fused kernel path is active — it
-    # needs the whole generation in the chunk ring).
+    # Decode ring/merged tiers (fresh after the suffix prefill; the fused
+    # kernel path needs the whole generation in the chunk ring).
     RC = ch if _use_merged(cfg) else n_chunks * ch
     PM = n_chunks if _use_merged(cfg) else 0
-    kvh_kd = cache.rk.shape[3:]
-    kvh_vd = cache.rv.shape[3:]
-    cache = cache._replace(
-        rk=jnp.zeros((L, RC, B) + kvh_kd, cache.rk.dtype),
-        rv=jnp.zeros((L, RC, B) + kvh_vd, cache.rv.dtype),
-        rpos=jnp.zeros((B, RC), jnp.int32),
-        rvalid=jnp.zeros((B, RC), jnp.bool_),
-        rlen=jnp.int32(0),
-        mk=jnp.zeros((L, PM, RC, B) + kvh_kd, cache.mk.dtype),
-        mv=jnp.zeros((L, PM, RC, B) + kvh_vd, cache.mv.dtype),
-        mpos=jnp.zeros((B, PM * RC), jnp.int32),
-        mvalid=jnp.zeros((B, PM * RC), jnp.bool_),
-        mlen=jnp.int32(0),
-    )
+
+    if batch_chunk is None and suffix_chunk is None:
+        # Monolithic path: one [B, Ss] suffix pass over an Ss-slot ring.
+        cache = init_cache(cfg, B, T, dtype, ring_len=Ss)
+
+        # 2) Broadcast the prefix KV into every row's slots [0, P0).
+        cache = _broadcast_prefix(cache, r0.cache, cfg, P0)
+        # Materialize the broadcast cache ONCE. Without the barrier XLA
+        # remats the fused broadcast_in_dim into every per-layer
+        # ``cache.k[l]`` slice of the decode loop, allocating ~n_layers
+        # simultaneous full-cache temps in a padded layout (2.0x at
+        # head_dim 64) — the round-5 bench RESOURCE_EXHAUSTED
+        # (BENCH_r05.json, transformer.py squeeze temps).
+        cache = lax.optimization_barrier(cache)
+
+        # 3) Per-row suffixes as one steered continuation chunk (ring path).
+        r = forward(
+            params, cfg, suffix_ids, suffix_mask, suffix_pos,
+            cache=cache, steer=steer_prompt, use_cache=True,
+            logits_mode="last",
+        )
+        cache = merge_ring(r.cache, cfg)
+        # Swap the (suffix-sized) ring for fresh decode tiers: the suffix
+        # rows now live in the main slots; decode starts from an
+        # all-invalid chunk ring (+ merged buffer).
+        kvh_kd = cache.rk.shape[3:]
+        kvh_vd = cache.rv.shape[3:]
+        cache = cache._replace(
+            rk=jnp.zeros((L, RC, B) + kvh_kd, cache.rk.dtype),
+            rv=jnp.zeros((L, RC, B) + kvh_vd, cache.rv.dtype),
+            rpos=jnp.zeros((B, RC), jnp.int32),
+            rvalid=jnp.zeros((B, RC), jnp.bool_),
+            rlen=jnp.int32(0),
+            mk=jnp.zeros((L, PM, RC, B) + kvh_kd, cache.mk.dtype),
+            mv=jnp.zeros((L, PM, RC, B) + kvh_vd, cache.mv.dtype),
+            mpos=jnp.zeros((B, PM * RC), jnp.int32),
+            mvalid=jnp.zeros((B, PM * RC), jnp.bool_),
+            mlen=jnp.int32(0),
+        )
+        logits0 = r.logits
+    else:
+        # Blocked path: per-block prefix broadcast + bucketed suffix
+        # passes, written straight into the decode-shaped cache. Peak
+        # prefill temps scale with block_batch x sub_width, not B x Ss.
+        plan = prefill_plan(B, Ss, batch_chunk, suffix_chunk)
+        Sc = plan.sub_width
+        # Block slot buffers are padded to whole sub-chunks so every
+        # merge_ring write fits without start-index clamping (the final
+        # narrower sub-chunk still merges at an un-clamped offset; its
+        # over-reach rows stay slot_mask=False and are sliced off below).
+        T_blk = P0 + len(plan.subs) * Sc
+        full = init_cache(cfg, B, T, dtype, ring_len=RC, merged_pages=PM)
+        fk, fv, fsm, fpos = full.k, full.v, full.slot_mask, full.positions
+        logits_parts = []
+        for b0, bc in plan.blocks:
+            bcache = init_cache(cfg, bc, T_blk, dtype, ring_len=Sc)
+            bcache = _broadcast_prefix(bcache, r0.cache, cfg, P0)
+            bcache = lax.optimization_barrier(bcache)
+            steer_blk = SteerSpec(
+                _slice_rows(steer_prompt.layer_idx, b0, bc),
+                _slice_rows(steer_prompt.strength, b0, bc),
+                steer_prompt.vectors[b0:b0 + bc],
+                steer_prompt.pos_mask[b0:b0 + bc],
+            )
+            r = None
+            for si, (s0, sc) in enumerate(plan.subs):
+                last = si == len(plan.subs) - 1
+                r = forward(
+                    params, cfg,
+                    suffix_ids[b0:b0 + bc, s0:s0 + sc],
+                    suffix_mask[b0:b0 + bc, s0:s0 + sc],
+                    suffix_pos[b0:b0 + bc, s0:s0 + sc],
+                    cache=bcache,
+                    steer=steer_blk._replace(
+                        pos_mask=steer_blk.pos_mask[:, s0:s0 + sc]
+                    ),
+                    use_cache=True,
+                    logits_mode="last" if last else "none",
+                )
+                bcache = merge_ring(r.cache, cfg)
+            logits_parts.append(r.logits)
+            fk = lax.dynamic_update_slice(
+                fk, bcache.k[:, :, :T], (0, b0, 0, 0, 0)
+            )
+            if fv.shape[-1]:
+                fv = lax.dynamic_update_slice(
+                    fv, bcache.v[:, :, :T], (0, b0, 0, 0, 0)
+                )
+            fsm = lax.dynamic_update_slice(fsm, bcache.slot_mask[:, :T], (b0, 0))
+            fpos = lax.dynamic_update_slice(fpos, bcache.positions[:, :T], (b0, 0))
+            # Chain blocks through a barrier: without it XLA is free to
+            # co-schedule independent blocks' prefill temps, recreating the
+            # full-rectangle peak the blocking exists to avoid.
+            fk, fv, fsm, fpos = lax.optimization_barrier((fk, fv, fsm, fpos))
+        cache = full._replace(
+            k=fk, v=fv, slot_mask=fsm, positions=fpos, length=jnp.int32(T)
+        )
+        logits0 = jnp.concatenate(logits_parts, axis=0)
+
     true_len = P0 + suffix_mask.sum(axis=1).astype(jnp.int32)
     return _sample_and_decode(
-        params, cfg, cache, r.logits, steer_decode, spec, true_len,
+        params, cfg, cache, logits0, steer_decode, spec, true_len,
         max_new_tokens, n_chunks, ch,
     )
 

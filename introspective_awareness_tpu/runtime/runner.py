@@ -26,7 +26,10 @@ from introspective_awareness_tpu.models.config import ModelConfig
 from introspective_awareness_tpu.models.registry import get_layer_at_fraction
 from introspective_awareness_tpu.models.tokenizer import Tokenizer, pad_batch
 from introspective_awareness_tpu.obs import NullLedger
-from introspective_awareness_tpu.obs.preflight import preflight as _hbm_preflight
+from introspective_awareness_tpu.obs.preflight import (
+    autotune as _hbm_autotune,
+    preflight as _hbm_preflight,
+)
 from introspective_awareness_tpu.parallel import ShardingRules
 from introspective_awareness_tpu.parallel import sharding as shax
 from introspective_awareness_tpu.models.transformer import forward, make_positions
@@ -62,6 +65,8 @@ class ModelRunner:
         prefix_min: int = 64,
         ledger=None,
         hbm_budget_frac: Optional[float] = None,
+        prefill_batch_chunk: Optional[int] = None,
+        prefill_suffix_chunk: Optional[int] = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -84,6 +89,14 @@ class ModelRunner:
         # per-device HBM before they ever run (obs.preflight).
         self.ledger = ledger if ledger is not None else NullLedger()
         self.hbm_budget_frac = hbm_budget_frac
+        # Chunked large-batch prefill (runtime/generate.py prefill_plan):
+        # bound peak prefill HBM by the [batch_chunk, suffix_chunk] block
+        # instead of the full [B, Ss] rectangle. None = monolithic. With an
+        # HBM budget set, _prefill_autotune walks batch_chunk down from the
+        # requested batch until the AOT memory plan fits the budget.
+        self.prefill_batch_chunk = prefill_batch_chunk
+        self.prefill_suffix_chunk = prefill_suffix_chunk
+        self.last_autotune: Optional[dict] = None
         self._aot_cache: dict = {}
         # Sequence parallelism: with a seq mesh axis > 1, S>1 chunks attend
         # via ring attention (ops/ring.py) and the shared-prefix split is
@@ -236,6 +249,69 @@ class ModelRunner:
             )
             self._aot_cache[key] = compiled
         return compiled
+
+    def _prefill_chunk_candidates(self, Bp: int):
+        """(batch_chunk, suffix_chunk) autotune candidates, largest first:
+        the configured chunking, then batch_chunk halving down to
+        ``batch_multiple``. The suffix chunk stays as configured — batch
+        blocking alone bounds the r05 broadcast-temp class, and halving a
+        single axis keeps the walk short and monotone in peak memory."""
+        bc0, sc0 = self.prefill_batch_chunk, self.prefill_suffix_chunk
+        cands: list = [(bc0, sc0)]
+        bc = bc0 or Bp
+        floor = max(self.batch_multiple, 1)
+        while bc > floor:
+            bc = max(bc // 2, floor)
+            cands.append((bc, sc0))
+        return cands
+
+    def _prefill_autotune(self, fn, fn_args: tuple, fn_kwargs: dict):
+        """AOT-preflight the chunked-prefill executable, walking the chunk
+        plan down until the memory plan fits the HBM budget (obs autotune).
+
+        The first candidate is the configured (prefill_batch_chunk,
+        prefill_suffix_chunk); each halving of batch_chunk roughly halves
+        peak prefill temp memory, so the walk terminates at the largest
+        memory-safe plan (or raises HbmPreflightError when even the floor
+        doesn't fit). Rejections emit preflight_skip ledger events naming
+        the offending buffers; the decision lands in ``self.last_autotune``
+        and an autotune_decision ledger event. Winners are cached per
+        abstract input signature like _aot_preflight — the cached
+        executable already embeds the winning chunk plan."""
+        traced = [a for a in fn_args if not isinstance(a, ModelConfig)]
+        leaves, treedef = jax.tree.flatten(traced)
+        base_kwargs = {
+            k: v for k, v in fn_kwargs.items()
+            if k not in ("batch_chunk", "suffix_chunk")
+        }
+        key = (
+            fn.__name__,
+            "autotune",
+            tuple(sorted(base_kwargs.items())),
+            str(treedef),
+            tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
+        )
+        compiled = self._aot_cache.get(key)
+        if compiled is not None:
+            return compiled
+        Bp = fn_args[3].shape[0]  # padded suffix batch
+
+        def build(cand):
+            bc, sc = cand
+            return fn.lower(
+                *fn_args, **base_kwargs, batch_chunk=bc, suffix_chunk=sc
+            ).compile()
+
+        result = _hbm_autotune(
+            self._prefill_chunk_candidates(Bp),
+            build,
+            label=fn.__name__,
+            budget_frac=self.hbm_budget_frac,
+            ledger=self.ledger,
+        )
+        self.last_autotune = result.as_dict()
+        self._aot_cache[key] = result.compiled
+        return result.compiled
 
     def _decode_row(self, row: np.ndarray) -> str:
         out = []
@@ -413,7 +489,11 @@ class ModelRunner:
                 jnp.asarray(np.asarray(rows[0][:L0], np.int32)),
                 ids, mask, spec,
             )
-            fn_kwargs = {"max_new_tokens": max_new_tokens}
+            fn_kwargs = {
+                "max_new_tokens": max_new_tokens,
+                "batch_chunk": self.prefill_batch_chunk,
+                "suffix_chunk": self.prefill_suffix_chunk,
+            }
         else:
             fn = generate_tokens
             fn_args = (self.params, self.cfg, ids, mask, spec)
@@ -426,7 +506,13 @@ class ModelRunner:
             model=self.model_name,
         ) as sp:
             if self.hbm_budget_frac is not None:
-                compiled = self._aot_preflight(fn, fn_args, fn_kwargs)
+                if fn is generate_tokens_prefix:
+                    # Chunk-plan autotune: walk batch_chunk down from the
+                    # configured plan to the largest one whose AOT memory
+                    # plan fits the budget (rejections → preflight_skip).
+                    compiled = self._prefill_autotune(fn, fn_args, fn_kwargs)
+                else:
+                    compiled = self._aot_preflight(fn, fn_args, fn_kwargs)
                 tokens = compiled(*(
                     a for a in fn_args if not isinstance(a, ModelConfig)
                 ))
@@ -600,7 +686,7 @@ class ModelRunner:
         slots: Optional[int] = None,
         refill_frac: float = 0.25,
         pipeline: bool = True,
-        staged: bool = False,
+        staged: Optional[bool] = None,
         lookahead: int = 2,
         suffix_bucket: int = 16,
         result_cb: Optional[Callable[[int, str], None]] = None,
@@ -621,7 +707,10 @@ class ModelRunner:
         switches admission to staged suffix prefill (overlapped with
         decode; also output-identical), with ``lookahead`` staging waves
         kept in the pool and stage widths quantized to ``suffix_bucket``
-        tokens. When ``result_cb`` is given it receives ``(queue_index,
+        tokens; the default ``staged=None`` auto-enables it at big slot
+        counts (``scheduler.STAGED_AUTO_SLOTS``) so large-batch admission
+        prefill runs at bucketed shapes instead of the full ``[B, Ss]``
+        rectangle. When ``result_cb`` is given it receives ``(queue_index,
         decoded_text)`` the moment each trial finishes — while decode
         continues — so the caller can stream finished trials into judge
         grading; the final return value is still the full in-order list.

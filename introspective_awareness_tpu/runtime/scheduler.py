@@ -83,6 +83,13 @@ from introspective_awareness_tpu.runtime.generate import (
 
 import jax.numpy as jnp
 
+# Slot count at which run_scheduled(staged=None) auto-enables staged
+# admission: at >= this many decode slots the synchronous refill's full
+# [B, Ss] suffix prefill is both a pipeline serialization point and the
+# r05 HBM OOM shape class, while the bucketed [R, Sb] staged path bounds
+# admission prefill memory by the group shape.
+STAGED_AUTO_SLOTS = 64
+
 
 @dataclass(frozen=True)
 class TrialRequest:
@@ -164,7 +171,7 @@ def run_scheduled(
     refill_frac: float = 0.25,
     ledger=None,
     pipeline: bool = True,
-    staged: bool = False,
+    staged: Optional[bool] = None,
     lookahead: int = 2,
     suffix_bucket: int = 16,
     result_cb: Optional[Callable[[int, np.ndarray], None]] = None,
@@ -194,7 +201,11 @@ def run_scheduled(
     scales how many admission waves of rows staging keeps in the pool
     (floored at one full batch). Greedy outputs are bit-identical to
     ``staged=False``; ``suffix_bucket <= 0`` disables width bucketing
-    (every stage pads to the queue-wide ``Ss``).
+    (every stage pads to the queue-wide ``Ss``). The default ``staged=None``
+    auto-routes: big slot counts (>= ``STAGED_AUTO_SLOTS``) use staged
+    admission, so their refill prefill runs at bucketed ``[R, Sb]`` shapes
+    instead of the full ``[B, Ss]`` rectangle — the r05 OOM class — while
+    small batches keep the simpler synchronous refill.
 
     ``trial_ids`` names each trial's PRNG stream index (default: its queue
     position). A resumed sweep passes the ORIGINAL queue indices of the
@@ -212,6 +223,8 @@ def run_scheduled(
     """
     ledger = ledger if ledger is not None else NullLedger()
     B = slots
+    if staged is None:
+        staged = B >= STAGED_AUTO_SLOTS
     N = len(trials)
     if N == 0:
         return [], {"chunks": 0, "refills": 0, "mean_slot_occupancy": 0.0,

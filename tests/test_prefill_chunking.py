@@ -1,0 +1,290 @@
+"""Chunked large-batch prefill: bit-identity vs the monolithic path, the
+AOT memory-regression guard for the r05 broadcast-temp class, and the
+HBM-aware chunk-plan autotuner.
+
+The equivalence tests are the contract that makes chunking a pure memory
+optimization: routing ``generate_tokens_prefix`` through [rows <= B,
+cols <= Ss] blocks must produce the SAME tokens, greedy and sampled, as the
+single monolithic prefill — the batch axis is never reduced over and
+masked-out keys contribute exact-0 probability, so the decomposition is
+lossless, not approximately so.
+
+The memory test pins the actual r05 failure: at batch 256 the monolithic
+prefill materializes full-batch rank-4 [B, S, NH, D] temps whose TPU tiling
+padding expands them past HBM. CPU executables expose the same
+``memory_analysis()`` temp accounting and the same HLO text, so the
+regression is assertable without a TPU; ``max_new_tokens=1`` drops the
+decode while_loop so the program IS the prefill.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu import obs
+from introspective_awareness_tpu.models.config import tiny_config
+from introspective_awareness_tpu.models.transformer import init_params
+from introspective_awareness_tpu.obs.preflight import (
+    HbmPreflightError,
+    modeled_padded_bytes,
+    scan_hlo_temps,
+)
+from introspective_awareness_tpu.runtime.generate import (
+    GenSpec,
+    generate_tokens_prefix,
+    prefill_plan,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    # One layer keeps every (batch_chunk, suffix_chunk) plan a cheap compile;
+    # the block/sub-chunk seams under test are applied per layer identically,
+    # so layer count adds compile time, not coverage.
+    cfg = tiny_config(n_layers=1)
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def _workload(cfg, B, Ss, seed=0):
+    rng = np.random.default_rng(seed)
+    prefix = np.asarray(rng.integers(1, 200, size=(11,)), np.int32)
+    sfx = np.asarray(rng.integers(1, 200, size=(B, Ss)), np.int32)
+    mask = np.ones((B, Ss), np.int32)
+    for b in range(B):  # ragged rows, LEFT-padded like ModelRunner._prep
+        mask[b, : (b * 3) % (Ss // 2)] = 0
+    sfx = sfx * mask
+    spec = GenSpec(
+        rng=jax.random.key(7), temperature=jnp.float32(0.0),
+        steer_layer=jnp.int32(0), steer_strength=jnp.float32(3.0),
+        steer_vectors=jnp.asarray(
+            rng.normal(size=(B, cfg.hidden_size)), jnp.float32),
+        steer_start=jnp.asarray(rng.integers(0, Ss, size=(B,)), jnp.int32),
+        eos_ids=jnp.asarray([9999], jnp.int32), pad_id=jnp.int32(0),
+    )
+    return prefix, sfx, mask, spec
+
+
+def _gen(params, cfg, prefix, sfx, mask, spec, temp, bc, sc, max_new=10):
+    # Fresh host copies every call: the suffix operands are donated.
+    return np.asarray(generate_tokens_prefix(
+        params, cfg, prefix.copy(), sfx.copy(), mask.copy(),
+        spec._replace(temperature=jnp.float32(temp)),
+        max_new_tokens=max_new, batch_chunk=bc, suffix_chunk=sc,
+    ))
+
+
+# Batch chunks {full, B/2, B/4}, suffix buckets, and a mixed plan with
+# non-dividing chunk sizes (ragged final block AND sub-chunk). Each plan is
+# one compiled program; temperature is a traced operand, so greedy/sampled
+# share the executable.
+_PLANS = [(None, 6), (4, None), (2, None), (3, 5)]
+
+
+@pytest.mark.parametrize("temp", [0.0, 1.0], ids=["greedy", "sampled"])
+@pytest.mark.parametrize("bc,sc", _PLANS)
+def test_chunked_matches_monolithic(setup, bc, sc, temp):
+    cfg, params = setup
+    B, Ss = 8, 12
+    prefix, sfx, mask, spec = _workload(cfg, B, Ss)
+    ref = _gen(params, cfg, prefix, sfx, mask, spec, temp, None, None)
+    got = _gen(params, cfg, prefix, sfx, mask, spec, temp, bc, sc)
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_chunked_matches_monolithic_variants(setup):
+    # Flash prefill attention AND the fp8 KV cache in one config: both
+    # alternate code paths run under chunking for the cost of two compiles.
+    cfg, params = setup
+    c = dataclasses.replace(cfg, attn_impl="flash", kv_cache_dtype="fp8")
+    B, Ss = 8, 12
+    prefix, sfx, mask, spec = _workload(cfg, B, Ss, seed=3)
+    for temp in (0.0, 1.0):
+        ref = _gen(params, c, prefix, sfx, mask, spec, temp, None, None)
+        got = _gen(params, c, prefix, sfx, mask, spec, temp, 4, 6)
+        np.testing.assert_array_equal(ref, got)
+
+
+def test_chunked_matches_monolithic_mla():
+    cfg = tiny_config(
+        n_layers=1, kv_lora_rank=16, qk_nope_head_dim=8, qk_rope_head_dim=8,
+        v_head_dim=16, q_lora_rank=24,
+    )
+    params = init_params(cfg, jax.random.key(1), dtype=jnp.float32)
+    B, Ss = 6, 12
+    prefix, sfx, mask, spec = _workload(cfg, B, Ss, seed=5)
+    for temp in (0.0, 1.0):
+        ref = _gen(params, cfg, prefix, sfx, mask, spec, temp, None, None)
+        got = _gen(params, cfg, prefix, sfx, mask, spec, temp, 3, 6)
+        np.testing.assert_array_equal(ref, got)
+
+
+# ---- prefill_plan ----------------------------------------------------------
+
+
+def test_prefill_plan_partitions_exactly():
+    plan = prefill_plan(10, 25, 4, 8)
+    assert plan.blocks == ((0, 4), (4, 4), (8, 2))
+    assert plan.subs == ((0, 8), (8, 8), (16, 8), (24, 1))
+    assert plan.block_batch == 4 and plan.sub_width == 8
+    # exact cover, no overlap
+    assert sum(n for _, n in plan.blocks) == 10
+    assert sum(n for _, n in plan.subs) == 25
+
+
+def test_prefill_plan_monolithic_default():
+    plan = prefill_plan(16, 32, None, None)
+    assert plan.blocks == ((0, 16),) and plan.subs == ((0, 32),)
+    assert plan.block_batch == 16 and plan.sub_width == 32
+    # oversized chunks clamp to the whole extent
+    plan = prefill_plan(16, 32, 999, 999)
+    assert plan.blocks == ((0, 16),) and plan.subs == ((0, 32),)
+
+
+# ---- TPU tiling model + HLO temp scan --------------------------------------
+
+
+def test_modeled_padded_bytes_tiling():
+    # f32 [256,512,8,64]: second-minor 8 already aligned, minor 64 -> 128.
+    assert modeled_padded_bytes("f32", [256, 512, 8, 64]) == (
+        256 * 512 * 8 * 128 * 4)
+    # bf16 sublane multiple is 16: 8 -> 16 AND 64 -> 128 (the 4x r05 class).
+    assert modeled_padded_bytes("bf16", [256, 512, 8, 64]) == (
+        256 * 512 * 16 * 128 * 2)
+    assert modeled_padded_bytes("f32", []) == 4  # rank-0: one element
+    assert modeled_padded_bytes("f32", [100]) == 128 * 4  # lane pad only
+    assert modeled_padded_bytes("notadtype", [8, 8]) is None
+
+
+def test_scan_hlo_temps_filters():
+    hlo = "\n".join([
+        # fusion body: rewrite-internal value, owns no buffer
+        "%fused_computation.0 {",
+        "  %multiply.9 = bf16[256,512,8,64]{3,2,1,0} multiply(%p0, %p1)",
+        "}",
+        "ENTRY %main {",
+        # full-batch rank-4 broadcast temp: the offender class
+        "  %broadcast.1 = bf16[256,512,8,64]{3,2,1,0} broadcast(%x)",
+        # same shape but a view-ish opcode: excluded
+        "  %copy.1 = bf16[256,512,8,64]{3,2,1,0} copy(%broadcast.1)",
+        # per-block temp: leading dim below the batch floor
+        "  %fusion.2 = bf16[64,512,8,64]{3,2,1,0} fusion(%y)",
+        # full-batch but rank-2: wrong rank
+        "  %dot.3 = f32[256,4096]{1,0} dot(%a, %b)",
+        "}",
+    ])
+    out = scan_hlo_temps(hlo, min_bytes=1024, rank=4, min_leading_dim=256,
+                         entry_only=True)
+    assert [r["op"] for r in out] == ["broadcast.1"]
+    assert out[0]["expansion"] == pytest.approx(4.0)
+    # without entry_only the fusion-internal value is (mis)counted too
+    out = scan_hlo_temps(hlo, min_bytes=1024, rank=4, min_leading_dim=256)
+    assert {r["op"] for r in out} == {"broadcast.1", "multiply.9"}
+    # without the leading-dim floor the per-block temp shows up too
+    out = scan_hlo_temps(hlo, min_bytes=1024, rank=4, entry_only=True)
+    assert {r["op"] for r in out} == {"broadcast.1", "fusion.2"}
+
+
+# ---- AOT memory regression (the r05 batch-256 OOM class) -------------------
+
+
+def test_no_fullbatch_broadcast_temps_at_batch_256():
+    """Monolithic batch-256 prefill materializes full-batch rank-4 temps
+    with >1.5x tiling expansion; the chunked path must have ZERO, and at
+    most half the total temp bytes. Abstract params (eval_shape) keep this
+    compile-only."""
+    cfg = dataclasses.replace(
+        tiny_config(n_layers=1), n_heads=8, n_kv_heads=8, head_dim=64,
+        hidden_size=512, mlp_hidden=1024, attn_impl="flash",
+    )
+    B, P0, Ss = 256, 128, 384
+    params = jax.eval_shape(
+        lambda k: init_params(cfg, k, dtype=jnp.float32), jax.random.key(0))
+    sds = jax.ShapeDtypeStruct
+    spec = GenSpec(
+        rng=sds((), jax.random.key(0).dtype),
+        temperature=sds((), jnp.float32), steer_layer=sds((), jnp.int32),
+        steer_strength=sds((), jnp.float32),
+        steer_vectors=sds((B, cfg.hidden_size), jnp.float32),
+        steer_start=sds((B,), jnp.int32),
+        eos_ids=sds((1,), jnp.int32), pad_id=sds((), jnp.int32),
+    )
+
+    def compile_(bc, sc):
+        # max_new_tokens=1: no decode while_loop, the program IS the prefill
+        return generate_tokens_prefix.lower(
+            params, cfg, sds((P0,), jnp.int32), sds((B, Ss), jnp.int32),
+            sds((B, Ss), jnp.int32), spec, max_new_tokens=1,
+            batch_chunk=bc, suffix_chunk=sc,
+        ).compile()
+
+    mono, chunked = compile_(None, None), compile_(64, None)
+    scan = lambda c: scan_hlo_temps(
+        c.as_text(), rank=4, min_leading_dim=B, entry_only=True)
+    assert len(scan(mono)) > 0, "regression recipe lost its offenders"
+    assert scan(chunked) == []
+
+    ma_m, ma_c = mono.memory_analysis(), chunked.memory_analysis()
+    if ma_m is not None and ma_c is not None:  # backend-dependent
+        tm = int(ma_m.temp_size_in_bytes)
+        tc = int(ma_c.temp_size_in_bytes)
+        assert tc <= tm / 2, f"chunked temps {tc} not <= half of {tm}"
+
+
+# ---- autotune walk ---------------------------------------------------------
+
+
+class _Stats:
+    def __init__(self, temp_bytes):
+        self.temp_size_in_bytes = temp_bytes
+
+
+def test_autotune_walks_to_first_fitting_candidate():
+    ledger = obs.RunLedger()
+    built = []
+
+    def build(cand):
+        built.append(cand)
+        return _Stats({8: 800, 4: 600, 2: 400}[cand])
+
+    r = obs.autotune([8, 4, 2], build, label="t", hbm_bytes=1000,
+                     budget_frac=0.5, ledger=ledger)
+    assert r.chosen == 2 and r.tried == 3 and built == [8, 4, 2]
+    assert [x["reason"] for x in r.rejected] == ["over_budget"] * 2
+    names = [e.get("name") for e in ledger.events if e.get("ev") == "event"]
+    assert names.count("preflight_skip") == 2
+    assert names.count("autotune_decision") == 1
+
+
+def test_autotune_skips_failed_builds_and_raises_when_dry():
+    def build(cand):
+        if cand == 8:
+            raise RuntimeError("RESOURCE_EXHAUSTED: compile oom")
+        return _Stats(999)
+
+    with pytest.raises(HbmPreflightError):
+        obs.autotune([8, 4], build, hbm_bytes=1000, budget_frac=0.5)
+
+
+def test_autotune_no_budget_takes_first():
+    # No resolvable HBM size: the gate is log-only, first candidate wins.
+    r = obs.autotune([(None, None), (4, None)], lambda c: _Stats(10**15),
+                     hbm_bytes=None)
+    assert r.chosen == (None, None) and r.tried == 1
+    assert r.as_dict()["chosen"] == [None, None]
+
+
+def test_runner_prefill_chunk_candidate_walk():
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    r = ModelRunner.__new__(ModelRunner)
+    r.prefill_batch_chunk = None
+    r.prefill_suffix_chunk = None
+    r.batch_multiple = 8
+    assert r._prefill_chunk_candidates(64) == [
+        (None, None), (32, None), (16, None), (8, None)]
+    r.prefill_batch_chunk, r.prefill_suffix_chunk = 16, 32
+    assert r._prefill_chunk_candidates(64) == [(16, 32), (8, 32)]
