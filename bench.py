@@ -406,12 +406,12 @@ def _pipeline_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     cyc = [max(2, sched_max // 8)] * 5 + [sched_max]
     budgets = [cyc[i % len(cyc)] for i in range(N)]
 
-    def run(pipe, cb=None):
+    def run(pipe, cb=None, tr=None):
         return runner.generate_grid_scheduled(
             prompts, layers, list(vecs), strengths, max_new_tokens=sched_max,
             temperature=0.0, steering_start_positions=starts,
             budgets=budgets, seed=0, slots=slots, refill_frac=0.5,
-            pipeline=pipe, result_cb=cb,
+            pipeline=pipe, result_cb=cb, trace=tr,
         )
 
     def span_gauges():
@@ -466,6 +466,36 @@ def _pipeline_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     t_pipe = _time.perf_counter() - t0
     identical = sync_out == pipe_out
 
+    # Flight-recorder A/B on the pipelined leg (no grading, pure scheduler):
+    # the identical run with a ChunkTrace attached must cost nothing
+    # measurable — recording is one deque append per event. Best-of-3 per
+    # leg beats wall-clock jitter; the CPU smoke asserts the overhead stays
+    # under 2% (main()).
+    from introspective_awareness_tpu.obs import ChunkTrace
+
+    t_off = None
+    for _ in range(3):
+        t0 = _time.perf_counter()
+        run(True)
+        dt = _time.perf_counter() - t0
+        t_off = dt if t_off is None or dt < t_off else t_off
+    t_on, best_trace = None, None
+    for _ in range(3):
+        tr = ChunkTrace()
+        t0 = _time.perf_counter()
+        run(True, tr=tr)
+        dt = _time.perf_counter() - t0
+        if t_on is None or dt < t_on:
+            t_on, best_trace = dt, tr
+    overhead = max(0.0, t_on / t_off - 1.0) if t_off else 0.0
+    trace_doc = {
+        **best_trace.summary(),
+        "overhead_frac": round(overhead, 4),
+        "untraced_best_s": round(t_off, 3),
+        "traced_best_s": round(t_on, 3),
+        "per_chunk": best_trace.attribution(),
+    }
+
     r = {
         "slots": slots,
         "queue_trials": N,
@@ -493,13 +523,16 @@ def _pipeline_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
         },
         "grading_overlap_frac": gstats.get("grading_overlap_frac"),
         "graded_streamed": len(graded),
+        "trace": trace_doc,
     }
     log(
         f"  [pipeline] {N} trials x {slots} slots: sync {t_sync:.2f}s "
         f"(decode {t_sync_decode:.2f}s, bubble {r['bubble_frac']}) vs "
         f"pipelined {t_pipe:.2f}s (decode {t_pipe_decode:.2f}s, bubble "
         f"{r['bubble_frac_pipelined']}) -> {r['speedup']}x, "
-        f"identical={identical}, grading overlap={r['grading_overlap_frac']}"
+        f"identical={identical}, grading overlap={r['grading_overlap_frac']}; "
+        f"trace overhead {100 * overhead:.1f}% "
+        f"({t_off:.2f}s -> {t_on:.2f}s, {trace_doc['chunks']} chunks)"
     )
     return r
 
@@ -550,12 +583,12 @@ def _staged_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     cyc = [max(2, sched_max // 8)] * 5 + [sched_max]
     budgets = [cyc[i % len(cyc)] for i in range(N)]
 
-    def run(staged):
+    def run(staged, tr=None):
         return runner.generate_grid_scheduled(
             prompts, layers, list(vecs), strengths, max_new_tokens=sched_max,
             temperature=0.0, steering_start_positions=starts,
             budgets=budgets, seed=0, slots=slots, refill_frac=0.5,
-            staged=staged,
+            staged=staged, trace=tr,
         )
 
     def span_gauges():
@@ -578,6 +611,15 @@ def _staged_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     g_staged = span_gauges()
     identical = staged_out == sync_out
 
+    # Flight-recorder attribution on a staged run (untimed): stage/admit
+    # dispatch events plus any admission stalls land in the same per-chunk
+    # fractions, so the bench doc shows where the staged loop's wall goes.
+    from introspective_awareness_tpu.obs import ChunkTrace
+
+    tr = ChunkTrace()
+    run(True, tr=tr)
+    trace_doc = {**tr.summary(), "per_chunk": tr.attribution()}
+
     r = {
         "slots": slots,
         "queue_trials": N,
@@ -597,6 +639,7 @@ def _staged_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
         "decode_chunks": {
             "sync": g_sync.get("chunks"), "staged": g_staged.get("chunks"),
         },
+        "trace": trace_doc,
     }
     log(
         f"  [staged_prefill] {N} churny trials x {slots} slots: sync refill "
@@ -1269,6 +1312,34 @@ def main() -> None:
             f"under plan {hbm_model['prefill_plan']}"
         )
 
+    # Top-level trace block: the flight recorder's per-section attribution
+    # plus the A/B recording-overhead figure. On the CPU smoke the overhead
+    # bound is a hard assertion — if one deque append per event ever shows
+    # up in the wall clock, the "leave it on for whole sweeps" claim dies.
+    pipe_tr = None if pipe.get("skipped") else pipe.get("trace")
+    stg_tr = None if stg.get("skipped") else stg.get("trace")
+    trace_block = None
+    if pipe_tr or stg_tr:
+        trace_block = {
+            "pipeline": pipe_tr,
+            "staged_prefill": stg_tr,
+            "chunks": (
+                (pipe_tr or {}).get("chunks", 0)
+                + (stg_tr or {}).get("chunks", 0)
+            ),
+            "overhead_frac": (pipe_tr or {}).get("overhead_frac"),
+        }
+        if (
+            not on_tpu
+            and trace_block["overhead_frac"] is not None
+            and trace_block["overhead_frac"] > 0.02
+        ):
+            log(
+                f"FATAL: trace recording overhead "
+                f"{trace_block['overhead_frac']:.1%} > 2% on the CPU smoke"
+            )
+            raise SystemExit(1)
+
     # Live per-device HBM watermark (None off-TPU: CPU backends don't
     # report memory_stats).
     hbm_devices = []
@@ -1304,6 +1375,8 @@ def main() -> None:
         "staged_prefill": stg,
         "durability": dur,
         "prefill_memory": pmem,
+        "trace": trace_block,
+        "backend": backend,
         "phases": ledger.summary().get("phases", {}),
         "hbm_preflight": preflight_verdict,
         "hbm_budget_frac": args.hbm_budget_frac,

@@ -171,6 +171,22 @@ def build_parser() -> argparse.ArgumentParser:
                              "--scheduler continuous is active, 'off' "
                              "disables (resume stays cell-granular via "
                              "results.json markers), else an explicit path")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="Serve live telemetry over HTTP for the "
+                             "duration of the sweep: Prometheus-text "
+                             "/metrics, JSON /progress (trials done/total, "
+                             "evals/s, slot occupancy, breaker state, ETA), "
+                             "and /healthz on 127.0.0.1:<port> (0 = pick an "
+                             "ephemeral port; it is printed at startup). The "
+                             "final registry snapshot lands in "
+                             "run_manifest.json either way. Default off.")
+    parser.add_argument("--trace-out", type=str, default=None,
+                        help="Write a Chrome-trace/Perfetto JSON timeline "
+                             "of the continuous-scheduler decode (per-chunk "
+                             "dispatch/wait/harvest spans, admission stalls, "
+                             "grading windows) to this path at sweep end; "
+                             "open it at https://ui.perfetto.dev. Requires "
+                             "--scheduler continuous.")
     parser.add_argument("--inject-faults", type=str, default=None,
                         help="Deterministic fault injection for testing "
                              "recovery (also via IAT_FAULTS env): comma "

@@ -300,9 +300,13 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
     stop_event = getattr(args, "_stop_event", None)
     faults = getattr(args, "_faults", None)
     breaker = getattr(args, "_judge_breaker", None)
+    trace = getattr(args, "_trace", None)
+    progress = getattr(args, "_progress", None)
 
     # ---- vectors for every swept layer, one capture pass ------------------
     t0 = time.perf_counter()
+    if progress is not None:
+        progress.set_phase(f"extract/{model_name}")
     with ledger.span("extract", model=model_name, what="concept_vectors"):
         table = extract_concept_vectors_all_layers(
             runner,
@@ -370,6 +374,13 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
         ("forced_injection", range(args.n_trials + 1, args.n_trials + n_injection + 1)),
     ]
     cell_task_max = len(args.concepts) * max(n_injection, n_control)
+    if progress is not None and pending:
+        # /progress denominator: one eval per (cell, concept, trial) across
+        # all three pass types (injection + control + forced_injection).
+        progress.add_total(
+            len(pending) * len(args.concepts)
+            * (n_injection + n_control + n_injection)
+        )
     fuse = args.fuse_cells == "on" or (
         args.fuse_cells == "auto"
         and len(pending) > 1
@@ -394,7 +405,7 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
 
         return StreamingGradePool(
             judge, journal=journal, pass_key=pass_key,
-            faults=faults, breaker=breaker,
+            faults=faults, breaker=breaker, trace=trace,
         )
 
     if pending and fuse:
@@ -426,6 +437,8 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 # compile-carrying first pass and skew the warm-rate fields.
                 continue
             pass_key = f"fused/{trial_type}"
+            if progress is not None:
+                progress.set_phase(f"generate/{pass_key}")
             out = run_grid_pass(
                 runner, trial_type, tasks, vector_lookup,
                 max_new_tokens=args.max_tokens, temperature=args.temperature,
@@ -433,8 +446,10 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 scheduler=args.scheduler, staged=args.staged_prefill,
                 grade_pool=_make_pool(pass_key),
                 journal=journal, pass_key=pass_key,
-                stop_event=stop_event, faults=faults,
+                stop_event=stop_event, faults=faults, trace=trace,
             )
+            if progress is not None:
+                progress.add_done(len(out))
             fused += out
             # Pass-granular timings: the fused grid has no per-cell unit of
             # work, so the manifest records per-pass times instead. The
@@ -486,13 +501,18 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
             for trial_type, trial_nums in trial_plan:
                 tasks = [(c, t) for c in args.concepts for t in trial_nums]
                 pass_key = f"cell/{lf:.2f}/{strength}/{trial_type}"
-                results += run_trial_pass(
+                if progress is not None:
+                    progress.set_phase(f"generate/{pass_key}")
+                out = run_trial_pass(
                     runner, trial_type, tasks,
                     grade_pool=_make_pool(pass_key),
                     journal=journal, pass_key=pass_key,
-                    stop_event=stop_event, faults=faults,
+                    stop_event=stop_event, faults=faults, trace=trace,
                     **common,
                 )
+                results += out
+                if progress is not None:
+                    progress.add_done(len(out))
             t_cell = time.perf_counter() - t0
             t_gen += t_cell
             n_generated += len(results)
@@ -730,10 +750,11 @@ def _write_manifest(
 ) -> None:
     import jax
 
-    from introspective_awareness_tpu.obs import CompileAccounting
+    from introspective_awareness_tpu.obs import CompileAccounting, default_registry
 
     out_base.mkdir(parents=True, exist_ok=True)
     mesh = runner.mesh
+    trace = getattr(args, "_trace", None)
     manifest = {
         "model": runner.model_name,
         "n_layers": runner.n_layers,
@@ -756,6 +777,11 @@ def _write_manifest(
         # reordered grading).
         "compile_stats": CompileAccounting.install().delta_since(compile_before),
         "ledger": runner.ledger.summary(),
+        # Live-telemetry plane: final registry snapshot (the same series
+        # /metrics served during the run) plus the flight recorder's
+        # attribution summary when --trace-out was active.
+        "metrics": default_registry().snapshot(),
+        "trace": trace.summary() if trace is not None else None,
         "ledger_path": getattr(runner.ledger, "path", None),
         "hbm_budget_frac": getattr(args, "hbm_budget_frac", None),
         "prefill_chunks": [
@@ -975,6 +1001,59 @@ def main(argv: Optional[list[str]] = None) -> int:
         args._judge_breaker = CircuitBreaker()
     else:
         args._judge_breaker = None
+
+    # ---- live telemetry plane (--metrics-port / --trace-out) --------------
+    from introspective_awareness_tpu.obs import (
+        ChunkTrace,
+        MetricsServer,
+        ProgressTracker,
+    )
+
+    args._trace = None
+    if args.trace_out:
+        if args.scheduler != "continuous":
+            print(
+                "note: --trace-out requires --scheduler continuous; "
+                "no trace will be recorded"
+            )
+        else:
+            args._trace = ChunkTrace()
+    args._progress = progress = ProgressTracker()
+    progress.set_extra(models=models, output_dir=args.output_dir)
+    if args._judge_breaker is not None:
+        breaker = args._judge_breaker
+        progress.add_probe("judge_breaker", lambda: breaker.state)
+    metrics_server = None
+    if args.metrics_port is not None:
+        metrics_server = MetricsServer(
+            progress=progress, port=args.metrics_port
+        ).start()
+        print(
+            f"metrics: {metrics_server.url}/metrics  "
+            f"progress: {metrics_server.url}/progress"
+        )
+
+    try:
+        return _run_models(args, models, judge, ledger, mesh, rules)
+    finally:
+        if metrics_server is not None:
+            metrics_server.stop()
+        if args._trace is not None and args._trace.n_recorded:
+            args._trace.save_perfetto(args.trace_out)
+            print(
+                f"trace: {args.trace_out} "
+                f"({args._trace.n_recorded} events; open at "
+                f"https://ui.perfetto.dev)"
+            )
+
+
+def _run_models(args, models, judge, ledger, mesh, rules) -> int:
+    from introspective_awareness_tpu.cli.debug import write_debug_dumps
+    from introspective_awareness_tpu.cli.plots import (
+        create_cross_model_comparison_plots,
+        create_sweep_plots,
+    )
+    from introspective_awareness_tpu.cli.transcripts import extract_example_transcripts
 
     for model_name in models:
         print(f"=== {model_name} ===")

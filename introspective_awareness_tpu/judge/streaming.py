@@ -48,8 +48,12 @@ from introspective_awareness_tpu.judge.judge import (
     LLMJudge,
     reconstruct_trial_prompts,
 )
+from introspective_awareness_tpu.obs.registry import default_registry
 
 _STOP = object()
+
+# Numeric encoding of the breaker state for the live-metrics gauge.
+BREAKER_STATE_NUM = {"closed": 0, "half-open": 1, "open": 2}
 
 
 class CircuitBreaker:
@@ -70,6 +74,12 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at: Optional[float] = None
         self._probing = False
+        self._gauge = default_registry().gauge(
+            "iat_judge_breaker_state",
+            "judge circuit state at last transition "
+            "(0 closed, 1 half-open, 2 open)",
+        )
+        self._gauge.set(0)
 
     @property
     def state(self) -> str:
@@ -97,6 +107,7 @@ class CircuitBreaker:
             self._failures = 0
             self._opened_at = None
             self._probing = False
+        self._gauge.set(0)
 
     def record_failure(self) -> None:
         with self._lock:
@@ -104,6 +115,8 @@ class CircuitBreaker:
             self._failures += 1
             if self._failures >= self.failure_threshold:
                 self._opened_at = time.monotonic()
+            opened = self._opened_at is not None
+        self._gauge.set(BREAKER_STATE_NUM["open" if opened else "closed"])
 
 
 class StreamingGradePool:
@@ -131,6 +144,7 @@ class StreamingGradePool:
         breaker: Optional[CircuitBreaker] = None,
         max_attempts: int = 3,
         retry_delay_s: float = 0.1,
+        trace=None,
     ):
         self.judge = judge
         self.max_batch = max(1, int(max_batch))
@@ -140,6 +154,15 @@ class StreamingGradePool:
         self.breaker = breaker
         self.max_attempts = max(1, int(max_attempts))
         self.retry_delay_s = max(0.0, float(retry_delay_s))
+        # Telemetry: the flight recorder (grade-submit / grade-return
+        # windows land on its grading track) and live counters. ChunkTrace
+        # appends and registry incs are both thread-safe.
+        self.trace = trace
+        _reg = default_registry()
+        self._m_graded = _reg.counter(
+            "iat_judge_graded_total", "trials graded by the streaming pool")
+        self._m_deferred = _reg.counter(
+            "iat_judge_deferred_total", "trials deferred to post-hoc grading")
         self._q: queue.Queue = queue.Queue()
         self._lock = threading.Lock()
         self._graded: dict[int, dict] = {}
@@ -167,6 +190,8 @@ class StreamingGradePool:
         if self._finished:
             raise RuntimeError("StreamingGradePool already finished")
         self._submitted += 1
+        if self.trace is not None:
+            self.trace.grade_submit(idx)
         self._q.put((idx, idx if journal_key is None else journal_key, result))
 
     # -- worker side --------------------------------------------------------
@@ -241,6 +266,9 @@ class StreamingGradePool:
             if self.breaker is not None:
                 self.breaker.record_success()
             t1 = time.perf_counter()
+            if self.trace is not None:
+                self.trace.grade_window(t0, t1, len(idxs))
+            self._m_graded.inc(len(idxs))
             with self._lock:
                 self._windows.append((t0, t1))
                 for i, ev in zip(idxs, evaluated):
@@ -256,6 +284,7 @@ class StreamingGradePool:
         self, idxs: list[int], keys: list, results: list[dict],
         error: str, detail: str, attempts: int,
     ) -> None:
+        self._m_deferred.inc(len(idxs))
         with self._lock:
             self._deferred.extend(idxs)
         if self.journal is not None:

@@ -21,6 +21,17 @@ Four pieces, threaded through runner / sweep / judge / bench / scripts:
 - :mod:`~introspective_awareness_tpu.obs.timing` — the original wall-timer
   registry, profiler capture, and NaN/Inf sanitizers (promoted from
   ``utils/observability.py``, which still re-exports for back-compat).
+- :mod:`~introspective_awareness_tpu.obs.trace` — the chunk-level flight
+  recorder: bounded ring buffer of scheduler/grading events with
+  host-wait / device-busy / dispatch-gap / admission-stall attribution
+  per chunk and Chrome-trace/Perfetto export.
+- :mod:`~introspective_awareness_tpu.obs.registry` +
+  :mod:`~introspective_awareness_tpu.obs.http` — the live metrics plane:
+  process-wide counters/gauges/histograms (Prometheus text `/metrics`,
+  JSON `/progress`, manifest snapshot) behind ``--metrics-port``.
+- :mod:`~introspective_awareness_tpu.obs.regress` — the bench-trajectory
+  regression gate over the committed ``BENCH_r*.json`` history
+  (``scripts/perf_gate.py`` / the CI perf-gate job).
 """
 
 from introspective_awareness_tpu.obs.compile_stats import CompileAccounting
@@ -52,14 +63,24 @@ from introspective_awareness_tpu.obs.timing import (
     profile_trace,
     timed,
 )
+from introspective_awareness_tpu.obs.http import MetricsServer, ProgressTracker
+from introspective_awareness_tpu.obs.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+from introspective_awareness_tpu.obs.trace import ChunkTrace, format_attribution
 
 __all__ = [
     "AutotuneResult",
+    "ChunkTrace",
     "CompileAccounting",
     "HbmPreflightError",
+    "MetricsRegistry",
+    "MetricsServer",
     "NullLedger",
     "PHASES",
     "PipelineGauges",
+    "ProgressTracker",
     "RecoveryGauges",
     "StagedGauges",
     "PreflightReport",
@@ -67,6 +88,8 @@ __all__ = [
     "Span",
     "Timings",
     "autotune",
+    "default_registry",
+    "format_attribution",
     "device_hbm_bytes",
     "enable_compilation_cache",
     "enable_debug_checks",

@@ -1,0 +1,207 @@
+"""Live metrics exposition: ``/metrics`` (Prometheus text) + ``/progress``.
+
+Stdlib-only (``http.server``) so the telemetry plane adds no
+dependencies: the sweep CLI starts a :class:`MetricsServer` when
+``--metrics-port`` is given (0 = ephemeral — the chosen port is printed
+and stored on the server), serving
+
+- ``GET /metrics``  — Prometheus text exposition 0.0.4 of the process
+  registry (scrapeable by a pod-local Prometheus sidecar);
+- ``GET /progress`` — one JSON doc for humans and dashboards: trials
+  done/total, evals/s, ETA, phase, plus every single-series gauge and
+  counter in the registry (slot occupancy, breaker state, journal
+  counts) without per-endpoint wiring;
+- ``GET /healthz``  — liveness probe.
+
+The server runs daemon-threaded (``ThreadingHTTPServer``), so a hung
+scrape can never wedge the scheduler; ``stop()`` is idempotent and the
+class doubles as a context manager.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+from introspective_awareness_tpu.obs.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class ProgressTracker:
+    """Thread-safe sweep progress shared between the scheduler/CLI threads
+    and the HTTP handler. ``add_probe`` registers late-bound readouts
+    (e.g. the judge breaker's live state) evaluated per snapshot."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._total = 0
+        self._done = 0
+        self._phase = ""
+        self._extra: dict[str, Any] = {}
+        self._probes: dict[str, Callable[[], Any]] = {}
+
+    def set_total(self, n: int) -> None:
+        with self._lock:
+            self._total = int(n)
+
+    def add_total(self, n: int) -> None:
+        with self._lock:
+            self._total += int(n)
+
+    def add_done(self, n: int = 1) -> None:
+        with self._lock:
+            self._done += int(n)
+
+    def set_phase(self, phase: str) -> None:
+        with self._lock:
+            self._phase = str(phase)
+
+    def set_extra(self, **kw: Any) -> None:
+        with self._lock:
+            self._extra.update(kw)
+
+    def add_probe(self, name: str, fn: Callable[[], Any]) -> None:
+        with self._lock:
+            self._probes[name] = fn
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            total, done, phase = self._total, self._done, self._phase
+            elapsed = time.perf_counter() - self._t0
+            extra = dict(self._extra)
+            probes = dict(self._probes)
+        rate = done / elapsed if elapsed > 0 and done else 0.0
+        out: dict[str, Any] = {
+            "trials_total": total,
+            "trials_done": done,
+            "phase": phase,
+            "elapsed_s": round(elapsed, 3),
+            "evals_per_s": round(rate, 4),
+            "eta_s": (
+                round((total - done) / rate, 1)
+                if rate > 0 and total > done else None
+            ),
+            "unix_time": time.time(),
+        }
+        out.update(extra)
+        for name, fn in probes.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # noqa: BLE001 - probes must not 500
+                out[name] = f"<probe error: {type(e).__name__}>"
+        return out
+
+
+def _progress_doc(registry: MetricsRegistry,
+                  progress: Optional[ProgressTracker]) -> dict[str, Any]:
+    doc = progress.snapshot() if progress is not None else {}
+    gauges: dict[str, float] = {}
+    counters: dict[str, float] = {}
+    for name, m in registry.snapshot()["metrics"].items():
+        if m["type"] == "histogram":
+            continue
+        series = m["series"]
+        if len(series) == 1 and not series[0]["labels"]:
+            (gauges if m["type"] == "gauge" else counters)[name] = (
+                series[0]["value"]
+            )
+        else:
+            dst = gauges if m["type"] == "gauge" else counters
+            for row in series:
+                lab = ",".join(f"{k}={v}" for k, v in row["labels"].items())
+                dst[f"{name}{{{lab}}}"] = row["value"]
+    doc["gauges"] = gauges
+    doc["counters"] = counters
+    return doc
+
+
+class MetricsServer:
+    """ThreadingHTTPServer wrapper behind ``--metrics-port``."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 progress: Optional[ProgressTracker] = None,
+                 port: int = 0, host: str = "127.0.0.1") -> None:
+        self.registry = registry if registry is not None else default_registry()
+        self.progress = progress
+        self._host = host
+        self._want_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("MetricsServer not started")
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self) -> "MetricsServer":
+        registry, progress = self.registry, self.progress
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a: Any) -> None:  # silence stderr spam
+                pass
+
+            def _send(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._send(200, PROM_CONTENT_TYPE,
+                               registry.render_prometheus().encode())
+                elif path == "/progress":
+                    self._send(200, "application/json",
+                               json.dumps(_progress_doc(
+                                   registry, progress)).encode())
+                elif path == "/healthz":
+                    self._send(200, "text/plain", b"ok\n")
+                else:
+                    self._send(404, "text/plain", b"not found\n")
+
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._want_port), _Handler
+        )
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "MetricsServer":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+__all__ = [
+    "MetricsServer",
+    "ProgressTracker",
+    "PROM_CONTENT_TYPE",
+]

@@ -17,8 +17,12 @@ so spans line up 1:1 with named regions in an xprof/TensorBoard trace
 captured via ``profile_trace``.
 
 The ledger is cheap enough to leave on unconditionally in-memory; pass a
-path to also stream JSONL to disk (flushed per event, so a preempted run
-keeps everything up to the last closed span).
+path to also stream JSONL to disk. Disk streaming is crash-durable the
+same way the trial journal is: every record is flushed to the OS, and an
+``fsync`` lands every ``fsync_every`` records (plus one on ``close``),
+so a preemption loses at most the last batch of records to a power cut
+and nothing to a process kill. A torn final line (killed mid-write) is
+dropped by :func:`load_ledger` instead of poisoning the whole file.
 """
 
 from __future__ import annotations
@@ -81,12 +85,15 @@ class RunLedger:
     """Collects :class:`Span` events in memory and (optionally) as JSONL."""
 
     def __init__(self, path: Optional[str] = None,
-                 n_chips: Optional[int] = None) -> None:
+                 n_chips: Optional[int] = None,
+                 fsync_every: int = 16) -> None:
         self.path = str(path) if path else None
         self.n_chips = int(n_chips) if n_chips else jax.device_count()
+        self.fsync_every = max(1, int(fsync_every))
         self.events: list[dict[str, Any]] = []
         self._stack: list[Span] = []
         self._next_id = 0
+        self._unsynced = 0
         self._fh = None
         if self.path:
             d = os.path.dirname(self.path)
@@ -109,7 +116,16 @@ class RunLedger:
         self.events.append(record)
         if self._fh is not None:
             self._fh.write(json.dumps(record) + "\n")
+            # Same durability batching as the trial journal: flush every
+            # record (survives process death), fsync every fsync_every-th
+            # (bounds what a power cut can take to one batch) — the
+            # scheduler's per-chunk slot_occupancy events make per-record
+            # fsync a hot-loop cost.
             self._fh.flush()
+            self._unsynced += 1
+            if self._unsynced >= self.fsync_every:
+                os.fsync(self._fh.fileno())
+                self._unsynced = 0
 
     def event(self, name: str, **attrs: Any) -> None:
         """Record an instantaneous point event (e.g. a preflight verdict)."""
@@ -182,9 +198,29 @@ class RunLedger:
         Only top-level occurrences of each phase are summed (a ``decode``
         nested inside a ``generate`` still gets its own phase row, but a
         phase is never double-counted against itself).
+
+        Nested spans of DIFFERENT phases used to double-count: a
+        ``decode_chunk`` inside ``generate_scheduled`` contributed its
+        wall/device time to both phase rows, so summing the table
+        over-reported the run. Each row now also carries ``self_wall_s``
+        / ``self_block_s`` — exclusive time, attributed to the innermost
+        span (a span's children's inclusive time subtracted, floored at
+        0) — and these DO sum to the run's wall across phases. The
+        inclusive ``wall_s``/``block_s`` stay for throughput math
+        (tok/s against a phase's own elapsed time).
         """
         per: dict[str, dict[str, Any]] = {}
         by_id = {e["id"]: e for e in self.spans()}
+        # Inclusive child time per parent id, for exclusive attribution.
+        child_wall: dict[int, float] = {}
+        child_block: dict[int, float] = {}
+        for e in self.spans():
+            p = e.get("parent")
+            if p is not None and p in by_id:
+                child_wall[p] = child_wall.get(p, 0.0) + e["wall_s"]
+                child_block[p] = (
+                    child_block.get(p, 0.0) + e.get("block_s", 0.0)
+                )
 
         def ancestor_same_phase(e: dict[str, Any]) -> bool:
             p = e.get("parent")
@@ -198,12 +234,19 @@ class RunLedger:
             return False
 
         for e in self.spans():
-            if ancestor_same_phase(e):
-                continue
             row = per.setdefault(e["phase"], {
                 "count": 0, "wall_s": 0.0, "block_s": 0.0,
+                "self_wall_s": 0.0, "self_block_s": 0.0,
                 "tokens": 0, "evals": 0,
             })
+            # Exclusive time: every span contributes, so the self columns
+            # tile the run exactly once regardless of nesting shape.
+            row["self_wall_s"] += max(
+                0.0, e["wall_s"] - child_wall.get(e["id"], 0.0))
+            row["self_block_s"] += max(
+                0.0, e.get("block_s", 0.0) - child_block.get(e["id"], 0.0))
+            if ancestor_same_phase(e):
+                continue
             row["count"] += 1
             row["wall_s"] += e["wall_s"]
             row["block_s"] += e.get("block_s", 0.0)
@@ -213,6 +256,8 @@ class RunLedger:
             wall = max(row["wall_s"], 1e-9)
             row["wall_s"] = round(row["wall_s"], 4)
             row["block_s"] = round(row["block_s"], 4)
+            row["self_wall_s"] = round(row["self_wall_s"], 4)
+            row["self_block_s"] = round(row["self_block_s"], 4)
             if row["tokens"]:
                 row["tok_per_s"] = round(row["tokens"] / wall, 3)
             else:
@@ -233,6 +278,9 @@ class RunLedger:
 
     def close(self) -> None:
         if self._fh is not None:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._unsynced = 0
             self._fh.close()
             self._fh = None
 
@@ -275,11 +323,26 @@ class NullLedger:
 
 
 def load_ledger(path: str) -> list[dict[str, Any]]:
-    """Parse a JSONL ledger file back into event dicts."""
-    out = []
+    """Parse a JSONL ledger file back into event dicts.
+
+    A torn FINAL line — the signature a preemption leaves mid-write — is
+    dropped so an interrupted sweep's ledger always parses. Corruption
+    *before* the tail still raises: valid records after a bad line mean
+    the file was damaged some other way, and silently skipping would
+    misreport the run.
+    """
+    lines: list[str] = []
     with open(path, encoding="utf-8") as f:
         for line in f:
             line = line.strip()
             if line:
-                out.append(json.loads(line))
+                lines.append(line)
+    out: list[dict[str, Any]] = []
+    for i, line in enumerate(lines):
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break  # torn tail from a mid-write kill: drop it
+            raise
     return out

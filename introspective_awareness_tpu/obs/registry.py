@@ -1,0 +1,264 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Stdlib-only (no jax import — ``scripts/perf_gate.py`` and the CI
+perf-gate job load ``obs`` modules without an accelerator stack), fed by
+the scheduler hot loop, runner, trial journal, and judge circuit
+breaker; read by :mod:`~introspective_awareness_tpu.obs.http`'s
+``/metrics`` (Prometheus text exposition) and ``/progress`` endpoints,
+and snapshotted into ``run_manifest.json`` at sweep exit.
+
+Label sets are bounded: each metric holds at most ``max_series`` label
+combinations; further ones collapse into a single ``other`` series so a
+bug (or a per-trial label) can never grow the registry without bound.
+
+Metric updates are a dict lookup + float add under one registry lock —
+micro-seconds, safe to call per processed chunk. The hot loop fetches
+metric handles once per ``run_scheduled`` call and updates through them.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Optional, Sequence
+
+_OVERFLOW = "other"
+
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+class _Metric:
+    """Shared series bookkeeping; subclasses define the value semantics."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str],
+                 lock: threading.RLock, max_series: int) -> None:
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = lock
+        self.max_series = max(1, int(max_series))
+        self._series: dict[tuple, Any] = {}
+
+    def _key(self, labels: dict[str, Any]) -> tuple:
+        if not self.labelnames:
+            return ()
+        key = tuple(str(labels.get(n, "")) for n in self.labelnames)
+        if key not in self._series and len(self._series) >= self.max_series:
+            return (_OVERFLOW,) * len(self.labelnames)
+        return key
+
+    def _zero(self) -> Any:
+        return 0.0
+
+    def _cell(self, labels: dict[str, Any]) -> tuple:
+        key = self._key(labels)
+        if key not in self._series:
+            self._series[key] = self._zero()
+        return key
+
+    def series(self) -> list[tuple[dict[str, str], Any]]:
+        with self._lock:
+            return [
+                (dict(zip(self.labelnames, k)), v if not isinstance(v, list)
+                 else list(v))
+                for k, v in sorted(self._series.items())
+            ]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            key = self._cell(labels)
+            self._series[key] += amount
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: Any) -> None:
+        with self._lock:
+            key = self._cell(labels)
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        with self._lock:
+            key = self._cell(labels)
+            self._series[key] += amount
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str],
+                 lock: threading.RLock, max_series: int,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help, labelnames, lock, max_series)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def _zero(self) -> list:
+        # [per-bucket counts..., +Inf count, sum]
+        return [0] * (len(self.buckets) + 1) + [0.0]
+
+    def observe(self, value: float, **labels: Any) -> None:
+        with self._lock:
+            key = self._cell(labels)
+            cell = self._series[key]
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    cell[i] += 1
+                    break
+            else:
+                cell[len(self.buckets)] += 1
+            cell[-1] += value
+
+
+class MetricsRegistry:
+    """Thread-safe named-metric store with get-or-create semantics."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str], max_series: int,
+                       **kw: Any) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind} "
+                        f"with labels {m.labelnames}"
+                    )
+                return m
+            m = cls(name, help, labelnames, self._lock, max_series, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = (),
+                max_series: int = 64) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames, max_series)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = (),
+              max_series: int = 64) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames, max_series)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (), max_series: int = 64,
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   max_series, buckets=buckets)
+
+    def value(self, name: str, **labels: Any) -> Optional[float]:
+        """Current value of a counter/gauge series, None if absent."""
+        with self._lock:
+            m = self._metrics.get(name)
+        if m is None or isinstance(m, Histogram):
+            return None
+        key = m._key(labels)
+        with self._lock:
+            v = m._series.get(key)
+        return None if v is None else float(v)
+
+    def clear(self) -> None:
+        """Drop every metric (tests only — live handles go stale)."""
+        with self._lock:
+            self._metrics.clear()
+
+    # -- exposition ---------------------------------------------------------
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for labels, val in m.series():
+                lab = ",".join(
+                    f'{k}="{_escape(v)}"' for k, v in labels.items()
+                )
+                if isinstance(m, Histogram):
+                    base = f"{{{lab}," if lab else "{"
+                    cum = 0
+                    for b, c in zip(m.buckets, val):
+                        cum += c
+                        lines.append(
+                            f'{m.name}_bucket{base}le="{b}"}} {cum}'
+                        )
+                    cum += val[len(m.buckets)]
+                    lines.append(f'{m.name}_bucket{base}le="+Inf"}} {cum}')
+                    suffix = f"{{{lab}}}" if lab else ""
+                    lines.append(f"{m.name}_sum{suffix} {val[-1]}")
+                    lines.append(f"{m.name}_count{suffix} {cum}")
+                else:
+                    suffix = f"{{{lab}}}" if lab else ""
+                    v = int(val) if float(val).is_integer() else val
+                    lines.append(f"{m.name}{suffix} {v}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-able dump for ``run_manifest.json``."""
+        out: dict[str, Any] = {"unix_time": time.time()}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        mdump: dict[str, Any] = {}
+        for m in metrics:
+            rows = []
+            for labels, val in m.series():
+                if isinstance(m, Histogram):
+                    rows.append({
+                        "labels": labels,
+                        "buckets": dict(zip(
+                            [str(b) for b in m.buckets] + ["+Inf"], val[:-1]
+                        )),
+                        "sum": round(val[-1], 6),
+                        "count": int(sum(val[:-1])),
+                    })
+                else:
+                    rows.append({"labels": labels, "value": round(val, 6)})
+            mdump[m.name] = {"type": m.kind, "help": m.help, "series": rows}
+        out["metrics"] = mdump
+        return out
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem feeds by default."""
+    return _DEFAULT
+
+
+def _self_check() -> None:  # pragma: no cover - dev convenience
+    r = MetricsRegistry()
+    r.counter("c", "help", ("k",)).inc(2, k="x")
+    r.gauge("g").set(1.5)
+    r.histogram("h").observe(0.03)
+    json.dumps(r.snapshot())
+    r.render_prometheus()

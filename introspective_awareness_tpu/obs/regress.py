@@ -1,0 +1,217 @@
+"""Bench-trajectory regression gate.
+
+The perf trajectory lives in committed ``BENCH_r*.json`` round files
+(``{"n": round, "cmd": ..., "rc": ..., "parsed": <bench.py stdout doc>}``).
+Round r05 regressed to ``"parsed": null`` and nobody noticed until the
+crash was archaeology — this module makes the comparison machine-checked:
+load the current bench doc, compare its headline metrics against the
+newest *comparable* history entry with noise-tolerant thresholds, and
+emit a ``pass`` / ``regress`` / ``improve`` verdict that
+``scripts/perf_gate.py`` turns into a CI exit code.
+
+Comparability is backend-gated: a CPU smoke run is never judged against
+TPU history (the committed rounds are TPU). The backend is read from the
+doc's ``device_kind``/``backend`` fields when present, else parsed from
+the trailing ``", tpu)"`` of the headline ``unit`` string. No comparable
+history ⇒ verdict ``no_history`` (a real verdict, and a passing one —
+the gate's job is catching regressions where a baseline exists, not
+blocking fresh backends).
+
+Stdlib-only on purpose: the CI perf-gate job runs it without installing
+jax, via ``scripts/perf_gate.py`` loading this file directly.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import re
+from typing import Any, Optional
+
+# name -> (extractor, higher_is_better, rel_tol, abs_tol).
+# Tolerance: regression when the metric moves past
+# reference -/+ max(rel_tol * |reference|, abs_tol) in the bad direction.
+# bubble_frac / prefill_overlap_frac live in [0, 1] near the ends, so
+# they gate on absolute movement; throughputs gate relatively.
+
+
+def _value(doc: dict) -> Optional[float]:
+    return doc.get("value")
+
+
+def _decode_steps(doc: dict) -> Optional[float]:
+    rows = doc.get("batch_sweep") or []
+    best = [r.get("decode_steps_per_sec") for r in rows
+            if isinstance(r, dict) and not r.get("skipped")]
+    best = [v for v in best if v]
+    return max(best) if best else None
+
+
+def _bubble(doc: dict) -> Optional[float]:
+    return (doc.get("pipeline") or {}).get("bubble_frac")
+
+
+def _overlap(doc: dict) -> Optional[float]:
+    return (doc.get("staged_prefill") or {}).get("prefill_overlap_frac")
+
+
+HEADLINES: tuple = (
+    ("evals_per_sec_chip", _value, True, 0.10, 0.0),
+    ("decode_steps_per_sec", _decode_steps, True, 0.15, 0.0),
+    ("bubble_frac", _bubble, False, 0.0, 0.10),
+    ("prefill_overlap_frac", _overlap, True, 0.0, 0.10),
+)
+
+
+def backend_of(doc: Optional[dict]) -> Optional[str]:
+    """Best-effort backend name ("cpu" / "tpu" / "gpu") for a bench doc."""
+    if not isinstance(doc, dict):
+        return None
+    for key in ("backend", "device_kind"):
+        v = doc.get(key)
+        if isinstance(v, str):
+            for b in ("tpu", "gpu", "cpu"):
+                if b in v.lower():
+                    return b
+    unit = doc.get("unit")
+    if isinstance(unit, str):
+        m = re.search(r"\b(cpu|tpu|gpu)\)?\s*$", unit.lower())
+        if m:
+            return m.group(1)
+    return None
+
+
+def load_bench_doc(path: str) -> tuple[Optional[dict], Optional[int]]:
+    """Load a bench doc from either a raw ``bench.py`` stdout JSON or a
+    ``BENCH_r*.json`` round wrapper. Returns ``(doc_or_None, round_n)``
+    — ``None`` doc for a wrapper whose run crashed (``parsed: null``)."""
+    with open(path, encoding="utf-8") as f:
+        d = json.load(f)
+    if isinstance(d, dict) and "parsed" in d and "metric" not in d:
+        return d.get("parsed"), d.get("n")
+    return d, None
+
+
+def compare(current: dict, history: list[tuple[Optional[dict], Any]],
+            tol_scale: float = 1.0) -> dict[str, Any]:
+    """Gate ``current`` against ``history`` (oldest → newest, as
+    ``(doc, label)`` pairs; crashed rounds arrive as ``(None, label)``
+    and are reported but never compared).
+
+    Per metric, the reference is the NEWEST comparable (same-backend)
+    entry that actually carries the metric — the gate tracks the
+    trajectory's frontier, not its average. ``tol_scale`` widens every
+    tolerance band (CI uses >1 for noisy CPU runners).
+    """
+    cur_backend = backend_of(current)
+    skipped_rounds = [lab for doc, lab in history if doc is None]
+    comparable = [
+        (doc, lab) for doc, lab in history
+        if doc is not None and backend_of(doc) == cur_backend
+        and cur_backend is not None
+    ]
+    metrics: list[dict[str, Any]] = []
+    for name, extract, higher_better, rel_tol, abs_tol in HEADLINES:
+        cur = extract(current) if isinstance(current, dict) else None
+        ref = ref_lab = None
+        for doc, lab in reversed(comparable):
+            v = extract(doc)
+            if v is not None:
+                ref, ref_lab = float(v), lab
+                break
+        row: dict[str, Any] = {
+            "metric": name,
+            "current": cur,
+            "reference": ref,
+            "reference_round": ref_lab,
+            "higher_is_better": higher_better,
+        }
+        if cur is None or ref is None:
+            row["verdict"] = "skipped"
+            row["reason"] = (
+                "no current value" if cur is None else "no comparable history"
+            )
+            metrics.append(row)
+            continue
+        margin = max(rel_tol * abs(ref), abs_tol) * max(tol_scale, 0.0)
+        delta = float(cur) - ref
+        row["delta"] = round(delta, 4)
+        row["margin"] = round(margin, 4)
+        signed = delta if higher_better else -delta
+        if signed < -margin:
+            row["verdict"] = "regress"
+        elif signed > margin:
+            row["verdict"] = "improve"
+        else:
+            row["verdict"] = "pass"
+        metrics.append(row)
+
+    compared = [m for m in metrics if m["verdict"] != "skipped"]
+    if any(m["verdict"] == "regress" for m in compared):
+        verdict = "regress"
+    elif any(m["verdict"] == "improve" for m in compared):
+        verdict = "improve"
+    elif compared:
+        verdict = "pass"
+    else:
+        verdict = "no_history"
+    return {
+        "verdict": verdict,
+        "backend": cur_backend,
+        "tol_scale": tol_scale,
+        "n_history": len(history),
+        "n_comparable": len(comparable),
+        "crashed_rounds": skipped_rounds,
+        "metrics": metrics,
+    }
+
+
+def inject_regression(history: list[tuple[Optional[dict], Any]],
+                      factor: float = 0.5) -> dict:
+    """Synthesize a degraded "current" doc from the newest non-crashed
+    history entry: headline and decode throughput scaled by ``factor``,
+    overlap/bubble fractions pushed the bad way. Lets CI prove the gate's
+    regress path fires regardless of the runner's backend."""
+    base = None
+    for doc, _lab in reversed(history):
+        if doc is not None and doc.get("value") is not None:
+            base = doc
+            break
+    if base is None:
+        raise ValueError("no usable history entry to degrade")
+    cur = copy.deepcopy(base)
+    cur["value"] = base["value"] * factor
+    for row in cur.get("batch_sweep") or []:
+        if isinstance(row, dict) and row.get("decode_steps_per_sec"):
+            row["decode_steps_per_sec"] *= factor
+    if isinstance(cur.get("pipeline"), dict) and \
+            cur["pipeline"].get("bubble_frac") is not None:
+        cur["pipeline"]["bubble_frac"] = min(
+            1.0, cur["pipeline"]["bubble_frac"] + 0.5)
+    if isinstance(cur.get("staged_prefill"), dict) and \
+            cur["staged_prefill"].get("prefill_overlap_frac") is not None:
+        cur["staged_prefill"]["prefill_overlap_frac"] *= factor
+    return cur
+
+
+def format_report(result: dict[str, Any]) -> str:
+    lines = [
+        f"perf gate: {result['verdict'].upper()}  "
+        f"(backend={result['backend']}, "
+        f"{result['n_comparable']}/{result['n_history']} comparable rounds"
+        + (f", crashed: {result['crashed_rounds']}"
+           if result["crashed_rounds"] else "")
+        + ")"
+    ]
+    for m in result["metrics"]:
+        if m["verdict"] == "skipped":
+            lines.append(f"  {m['metric']:<24} skipped ({m['reason']})")
+        else:
+            arrow = "↑" if m["higher_is_better"] else "↓"
+            lines.append(
+                f"  {m['metric']:<24} {m['verdict']:<8}"
+                f" current={m['current']:.4f} ref={m['reference']:.4f}"
+                f" (round {m['reference_round']},"
+                f" Δ={m['delta']:+.4f}, margin=±{m['margin']:.4f}, good {arrow})"
+            )
+    return "\n".join(lines)

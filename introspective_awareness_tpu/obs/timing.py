@@ -16,6 +16,7 @@ one evals/sec print, eval_utils.py:766-767). Here:
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 from typing import Iterator, Optional
@@ -41,6 +42,11 @@ class Timings:
         return dict(self._counts)
 
 
+# Per-thread stack of open `timed` frames; each frame accumulates its
+# children's inclusive durations so the parent can record exclusive time.
+_timed_stack = threading.local()
+
+
 @contextlib.contextmanager
 def timed(
     name: str,
@@ -49,7 +55,19 @@ def timed(
     verbose: bool = False,
 ) -> Iterator[None]:
     """Wall-time a block; if ``result`` (array/pytree) is given, block until
-    it is ready so device work is included in the measurement."""
+    it is ready so device work is included in the measurement.
+
+    Nested ``timed`` blocks no longer double-count: the parent records its
+    EXCLUSIVE time (inclusive minus nested ``timed`` children), so summing
+    a ``Timings`` registry tiles the measured wall once — a
+    ``timed("generate")`` wrapping ``timed("decode_chunk")`` calls used to
+    book the chunk seconds under both names. Non-nested use is unchanged.
+    """
+    stack = getattr(_timed_stack, "frames", None)
+    if stack is None:
+        stack = _timed_stack.frames = []
+    frame = [0.0]  # children's inclusive seconds
+    stack.append(frame)
     t0 = time.perf_counter()
     try:
         yield
@@ -57,8 +75,11 @@ def timed(
         if result is not None:
             jax.block_until_ready(result)
         dt = time.perf_counter() - t0
+        stack.pop()
+        if stack:
+            stack[-1][0] += dt
         if timings is not None:
-            timings.add(name, dt)
+            timings.add(name, max(0.0, dt - frame[0]))
         if verbose:
             print(f"[timing] {name}: {dt:.3f}s")
 

@@ -1,0 +1,293 @@
+"""Chunk-level flight recorder for the scheduled decode loop.
+
+The ledger's ``PipelineGauges`` answer "how much host wait happened, in
+total?" — useful for A/B speedup rows, useless for "where does the other
+81% of the wall clock go?" (ROADMAP decode-speed item: 81% idle at 19%
+HBM utilization). :class:`ChunkTrace` answers the per-chunk question: a
+bounded ring buffer of timestamped events recorded inside the scheduler
+hot loop (dispatch / flags-landed / harvest / stage / admit) and the
+:class:`~introspective_awareness_tpu.judge.streaming.StreamingGradePool`
+(grade-submit / grade-return), with post-hoc attribution and a
+Chrome-trace/Perfetto JSON export so every sweep can produce an openable
+timeline.
+
+Recording is a single ``deque.append`` of a flat tuple per event —
+cheap enough to leave on for a whole sweep (bench A/B-asserts the hot
+loop overhead stays under 2% on the CPU smoke). The buffer is bounded
+(``capacity`` events, default 64k ≈ a few MB); once full, the oldest
+events fall off and ``dropped`` counts them, so a week-long sweep can
+keep a trace attached without unbounded growth.
+
+Attribution model — the loop is a chain of *processed* events (each
+``_process_one`` call). For each one, the interval since the previous
+processed event is split into four exhaustive, non-overlapping parts:
+
+- ``host_wait``    — the measured blocking window landing the event's
+  flags (``np.asarray`` on the async D2H copy);
+- ``dispatch_gap`` — the window between the previous harvest and this
+  op's dispatch when *nothing* was in flight (host-side bookkeeping /
+  staging sitting on the critical path; structurally 0 when pipelined);
+- ``admission_stall`` — pool-dry staging windows (the staged-admission
+  ``admit_wait`` gauge, per-chunk);
+- ``device_busy``  — the residual: the op was in flight and the host
+  did not have to wait for it.
+
+``device_busy`` is computed as the clamped residual, so the four
+fractions sum to 1.0 per chunk by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Any, Optional
+
+# Event tuple layout: (op, kind, seq, t0, t1).
+#   op:   "beg" | "disp" | "land" | "proc" | "stall" | "gsub" | "gret"
+#   kind: dispatch kind ("chunk" | "refill" | "stage") or None
+#   seq:  per-run dispatch sequence number (grade events: trial index / n)
+_PERF = time.perf_counter
+
+
+class ChunkTrace:
+    """Bounded ring buffer of scheduler/grading events + attribution."""
+
+    __slots__ = ("_ev", "capacity", "n_recorded")
+
+    def __init__(self, capacity: int = 65536) -> None:
+        self.capacity = max(16, int(capacity))
+        self._ev: deque = deque(maxlen=self.capacity)
+        self.n_recorded = 0
+
+    # -- hot-path recording (one tuple append each) -------------------------
+
+    def begin(self, t: Optional[float] = None) -> None:
+        """Anchor the first interval at the loop start."""
+        self.n_recorded += 1
+        self._ev.append(("beg", None, 0, _PERF() if t is None else t, 0.0))
+
+    def dispatch(self, kind: str, seq: int) -> None:
+        self.n_recorded += 1
+        self._ev.append(("disp", kind, seq, _PERF(), 0.0))
+
+    def landed(self, kind: str, seq: int, t0: float, t1: float) -> None:
+        """The blocking host wait that landed this op's flags."""
+        self.n_recorded += 1
+        self._ev.append(("land", kind, seq, t0, t1))
+
+    def processed(self, kind: str, seq: int) -> None:
+        """Harvest/bookkeeping for this op is complete."""
+        self.n_recorded += 1
+        self._ev.append(("proc", kind, seq, _PERF(), 0.0))
+
+    def stall(self, t0: float, t1: float) -> None:
+        """Staging ran with a dry pool while admission was demanded."""
+        self.n_recorded += 1
+        self._ev.append(("stall", None, 0, t0, t1))
+
+    def grade_submit(self, idx: int) -> None:
+        self.n_recorded += 1
+        self._ev.append(("gsub", None, idx, _PERF(), 0.0))
+
+    def grade_window(self, t0: float, t1: float, n: int) -> None:
+        self.n_recorded += 1
+        self._ev.append(("gret", None, n, t0, t1))
+
+    # -- introspection ------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._ev)
+
+    @property
+    def dropped(self) -> int:
+        return self.n_recorded - len(self._ev)
+
+    def events(self) -> list[tuple]:
+        return list(self._ev)
+
+    # -- post-hoc attribution ----------------------------------------------
+
+    def attribution(self) -> list[dict[str, Any]]:
+        """Per processed event: the four wall-clock fractions.
+
+        Only events still in the ring buffer contribute; after overflow
+        the earliest chunks are gone (``dropped`` says how many events
+        fell off) and attribution covers the surviving suffix.
+        """
+        ev = list(self._ev)
+        if not ev:
+            return []
+        disp_t: dict[tuple, float] = {}
+        land_w: dict[tuple, tuple[float, float]] = {}
+        stalls: list[tuple[float, float]] = []
+        # Merged chronological stream of chain anchors: each "beg" resets
+        # the interval chain, so a trace spanning several run_scheduled
+        # calls (one sweep = many passes) attributes every session instead
+        # of only the last one, and the idle gap BETWEEN sessions is never
+        # booked against the first chunk of the next.
+        marks: list[tuple[float, str, Any, Any]] = []
+        t_first = min(e[3] for e in ev)
+        for op, kind, seq, t0, t1 in ev:
+            if op == "disp":
+                disp_t[(kind, seq)] = t0
+            elif op == "land":
+                land_w[(kind, seq)] = (t0, t1)
+            elif op == "proc":
+                marks.append((t0, "proc", kind, seq))
+            elif op == "stall":
+                stalls.append((t0, t1))
+            elif op == "beg":
+                marks.append((t0, "beg", None, None))
+        marks.sort(key=lambda m: m[0])
+
+        def _overlap(a0: float, a1: float, b0: float, b1: float) -> float:
+            return max(0.0, min(a1, b1) - max(a0, b0))
+
+        rows: list[dict[str, Any]] = []
+        prev_end = t_first
+        for t_end, op, kind, seq in marks:
+            if op == "beg":
+                prev_end = max(prev_end, t_end)
+                continue
+            iv = t_end - prev_end
+            if iv <= 0.0:
+                prev_end = max(prev_end, t_end)
+                continue
+            w = land_w.get((kind, seq))
+            host_wait = _overlap(w[0], w[1], prev_end, t_end) if w else 0.0
+            td = disp_t.get((kind, seq))
+            # Gap where the device had nothing in flight: previous harvest
+            # until this op's dispatch (never negative under pipelining —
+            # the op was dispatched before the previous event landed).
+            gap0, gap1 = prev_end, min(td, t_end) if td is not None else prev_end
+            dispatch_gap = max(0.0, gap1 - gap0)
+            stall_s = sum(_overlap(s0, s1, prev_end, t_end) for s0, s1 in stalls)
+            # Stall windows sit inside the dispatch gap (staging happens
+            # before the dispatch it unblocks) — don't count them twice.
+            dispatch_gap = max(0.0, dispatch_gap - sum(
+                _overlap(s0, s1, gap0, gap1) for s0, s1 in stalls))
+            other = host_wait + dispatch_gap + stall_s
+            if other > iv:  # overlapping windows / clock jitter: rescale
+                scale = iv / other
+                host_wait *= scale
+                dispatch_gap *= scale
+                stall_s *= scale
+                other = iv
+            busy = iv - other
+            rows.append({
+                "kind": kind,
+                "seq": int(seq),
+                "t_end_s": round(t_end - t_first, 6),
+                "interval_s": round(iv, 6),
+                "host_wait_frac": round(host_wait / iv, 4),
+                "device_busy_frac": round(busy / iv, 4),
+                "dispatch_gap_frac": round(dispatch_gap / iv, 4),
+                "admission_stall_frac": round(stall_s / iv, 4),
+            })
+            prev_end = t_end
+        return rows
+
+    def summary(self) -> dict[str, Any]:
+        """Aggregate attribution: interval-weighted fractions over all
+        processed events plus chunk/refill counts, bench/manifest-ready."""
+        rows = self.attribution()
+        total = sum(r["interval_s"] for r in rows)
+        agg = {k: 0.0 for k in ("host_wait", "device_busy",
+                                "dispatch_gap", "admission_stall")}
+        if total > 0:
+            for r in rows:
+                for k in agg:
+                    agg[k] += r[f"{k}_frac"] * r["interval_s"]
+            for k in agg:
+                agg[k] /= total
+        out: dict[str, Any] = {
+            "chunks": sum(1 for r in rows if r["kind"] == "chunk"),
+            "refills": sum(1 for r in rows if r["kind"] == "refill"),
+            "events": self.n_recorded,
+            "dropped": self.dropped,
+            "attributed_s": round(total, 4),
+        }
+        for k, v in agg.items():
+            out[f"{k}_frac"] = round(v, 4)
+        out["fractions_sum"] = round(sum(agg.values()), 4) if total else None
+        return out
+
+    # -- Chrome-trace / Perfetto export -------------------------------------
+
+    def to_perfetto(self) -> dict[str, Any]:
+        """Chrome-trace JSON (the ``traceEvents`` array format): open in
+        https://ui.perfetto.dev or ``chrome://tracing``. Tracks: device
+        in-flight spans, host flag waits, admission stalls, grading."""
+        ev = list(self._ev)
+        if not ev:
+            return {"traceEvents": [], "displayTimeUnit": "ms"}
+        t_base = min(e[3] for e in ev)
+
+        def us(t: float) -> float:
+            return round((t - t_base) * 1e6, 3)
+
+        out: list[dict[str, Any]] = []
+        for pid, pname in ((1, "scheduler"), (2, "grading")):
+            out.append({"ph": "M", "name": "process_name", "pid": pid,
+                        "tid": 0, "args": {"name": pname}})
+        for pid, tid, tname in (
+            (1, 1, "device in-flight"), (1, 2, "host wait"),
+            (1, 3, "dispatch"), (1, 4, "admission stalls"),
+            (2, 1, "grade batches"), (2, 2, "submits"),
+        ):
+            out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                        "tid": tid, "args": {"name": tname}})
+
+        disp_t: dict[tuple, float] = {}
+        for op, kind, seq, t0, t1 in ev:
+            if op == "disp":
+                disp_t[(kind, seq)] = t0
+                out.append({"ph": "i", "name": f"dispatch {kind} #{seq}",
+                            "pid": 1, "tid": 3, "ts": us(t0), "s": "t"})
+            elif op == "land":
+                out.append({"ph": "X", "name": f"wait {kind} #{seq}",
+                            "pid": 1, "tid": 2, "ts": us(t0),
+                            "dur": max(round((t1 - t0) * 1e6, 3), 0.001)})
+            elif op == "proc":
+                td = disp_t.get((kind, seq), t0)
+                out.append({"ph": "X", "name": f"{kind} #{seq}",
+                            "pid": 1, "tid": 1, "ts": us(td),
+                            "dur": max(round((t0 - td) * 1e6, 3), 0.001),
+                            "args": {"kind": kind, "seq": int(seq)}})
+            elif op == "stall":
+                out.append({"ph": "X", "name": "admission stall",
+                            "pid": 1, "tid": 4, "ts": us(t0),
+                            "dur": max(round((t1 - t0) * 1e6, 3), 0.001)})
+            elif op == "gsub":
+                out.append({"ph": "i", "name": f"submit trial {seq}",
+                            "pid": 2, "tid": 2, "ts": us(t0), "s": "t"})
+            elif op == "gret":
+                out.append({"ph": "X", "name": f"grade batch [{seq}]",
+                            "pid": 2, "tid": 1, "ts": us(t0),
+                            "dur": max(round((t1 - t0) * 1e6, 3), 0.001),
+                            "args": {"batch_size": int(seq)}})
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+    def save_perfetto(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_perfetto(), f)
+        return path
+
+
+def format_attribution(summary: dict[str, Any]) -> str:
+    """Human-readable one-block rendering of :meth:`ChunkTrace.summary`
+    (shared by ``scripts/profile_decode.py`` and sweep logs)."""
+    if not summary or not summary.get("chunks"):
+        return "  trace: no chunks recorded"
+    lines = [
+        f"  trace: {summary['chunks']} chunks, {summary['refills']} refills"
+        f" over {summary['attributed_s']:.3f}s"
+        + (f" ({summary['dropped']} events dropped)"
+           if summary.get("dropped") else "")
+    ]
+    for k in ("device_busy", "host_wait", "dispatch_gap", "admission_stall"):
+        v = summary.get(f"{k}_frac")
+        if v is not None:
+            lines.append(f"    {k:<16} {100.0 * v:5.1f}%")
+    return "\n".join(lines)

@@ -41,6 +41,7 @@ def run_trial_pass(
     pass_key: Optional[str] = None,
     stop_event=None,
     faults=None,
+    trace=None,
 ) -> list[dict]:
     """One batched pass of a trial type over (concept, trial) tasks.
 
@@ -72,7 +73,7 @@ def run_trial_pass(
             batch_size=batch_size, seed=seed, scheduler="continuous",
             staged=staged, grade_pool=grade_pool,
             journal=journal, pass_key=pass_key,
-            stop_event=stop_event, faults=faults,
+            stop_event=stop_event, faults=faults, trace=trace,
         )
     if scheduler != "batch":
         raise ValueError(f"unknown scheduler {scheduler!r}")
@@ -143,6 +144,7 @@ def run_grid_pass(
     pass_key: Optional[str] = None,
     stop_event=None,
     faults=None,
+    trace=None,
 ) -> list[dict]:
     """One batched pass where every row may belong to a DIFFERENT
     (layer, strength) cell — the fused-sweep path.
@@ -183,7 +185,10 @@ def run_grid_pass(
     any cell can be saved, so a changed list normally replays everything).
     ``stop_event`` turns SIGTERM-style shutdown into a drained, journaled
     :class:`~introspective_awareness_tpu.runtime.journal.SweepInterrupted`;
-    ``faults`` threads the deterministic fault plan through.
+    ``faults`` threads the deterministic fault plan through. ``trace`` (a
+    :class:`~introspective_awareness_tpu.obs.ChunkTrace`; continuous only)
+    records per-chunk dispatch/land/harvest events for the flight-recorder
+    timeline and attribution.
     """
     if trial_type not in TRIAL_TYPES:
         raise ValueError(f"unknown trial_type {trial_type!r} (expected {TRIAL_TYPES})")
@@ -312,6 +317,7 @@ def run_grid_pass(
                     trial_ids=remaining if journal is not None else None,
                     stop_event=stop_event,
                     faults=faults,
+                    trace=trace,
                 )
             except SweepInterrupted:
                 # Graceful stop: everything harvested so far has already

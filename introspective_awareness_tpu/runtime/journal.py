@@ -53,6 +53,7 @@ from pathlib import Path
 from typing import Optional
 
 from introspective_awareness_tpu.obs.recovery import RecoveryGauges
+from introspective_awareness_tpu.obs.registry import default_registry
 
 
 class JournalError(RuntimeError):
@@ -122,6 +123,11 @@ class TrialJournal:
         self.config = json.loads(json.dumps(config))  # JSON-normalized
         self.fsync_every = max(1, int(fsync_every))
         self.gauges = RecoveryGauges()
+        self._m_records = default_registry().counter(
+            "iat_journal_records_total",
+            "durable journal records appended, by kind",
+            labelnames=("kind",),
+        )
         self._lock = threading.Lock()
         self._unsynced = 0
         # Replayed state: pass_key -> {trial key -> payload}. Trial keys are
@@ -260,6 +266,7 @@ class TrialJournal:
         if self._unsynced >= self.fsync_every:
             os.fsync(self._f.fileno())
             self._unsynced = 0
+        self._m_records.inc(kind=obj.get("ev", "unknown"))
 
     def record_decoded(self, pass_key: str, idx, result: dict) -> None:
         """One trial finalized by the scheduler (from ``result_cb``).

@@ -693,6 +693,7 @@ class ModelRunner:
         trial_ids: Optional[Sequence[int]] = None,
         stop_event=None,
         faults=None,
+        trace=None,
         **kw,
     ) -> list[str]:
         """Continuous-batching counterpart of
@@ -731,7 +732,10 @@ class ModelRunner:
         first, so the caller's journal is complete up to the stop).
         ``faults`` is a deterministic
         :class:`~introspective_awareness_tpu.runtime.faults.FaultPlan`
-        whose crash points fire between harvested chunks.
+        whose crash points fire between harvested chunks. ``trace`` (an
+        ``obs.trace.ChunkTrace``) attaches the per-chunk flight recorder
+        to the scheduler loop; the fixed-batch fallback has no chunk
+        boundaries to record and ignores it.
 
         Eligibility mirrors the shared-prefix path — every prompt must
         share a prefix no steered row steers inside (the sweep's preamble),
@@ -900,6 +904,7 @@ class ModelRunner:
                 pipeline=pipeline, staged=staged, lookahead=lookahead,
                 suffix_bucket=suffix_bucket, result_cb=tok_cb,
                 trial_ids=trial_ids, stop_event=stop_event, faults=faults,
+                trace=trace,
             )
             done = [r for r in results if r is not None]
             span.add_evals(len(done))

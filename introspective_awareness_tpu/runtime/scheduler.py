@@ -71,6 +71,7 @@ import numpy as np
 
 from introspective_awareness_tpu.models.config import ModelConfig
 from introspective_awareness_tpu.obs import NullLedger, PipelineGauges, StagedGauges
+from introspective_awareness_tpu.obs.registry import default_registry
 from introspective_awareness_tpu.runtime.generate import (
     SchedSpec,
     _chunk_plan,
@@ -124,6 +125,7 @@ class _InFlight:
     flags: jax.Array  # [2B] int32 — packed [done, n_emitted]
     toks: jax.Array  # chunk: [B, ch] token slab; refill: [B] tok0
     owners: np.ndarray  # [B] queue index per slot at dispatch (-1 = free)
+    seq: int = -1  # run-wide dispatch sequence number (ChunkTrace key)
 
 
 @dataclass
@@ -178,6 +180,7 @@ def run_scheduled(
     trial_ids: Optional[Sequence[int]] = None,
     stop_event=None,
     faults=None,
+    trace=None,
 ) -> tuple[list[np.ndarray], dict]:
     """Drain ``trials`` through ``slots`` decode rows; returns per-trial
     token arrays (input order, length = tokens actually emitted, final
@@ -220,6 +223,13 @@ def run_scheduled(
     True. ``faults`` (a ``runtime.faults.FaultPlan``) ticks deterministic
     crash-injection counters after each processed chunk and at each
     admission dispatch.
+
+    ``trace`` (an ``obs.trace.ChunkTrace``) attaches the per-chunk flight
+    recorder: every dispatch / flags-landed / harvest / stage boundary and
+    admission-stall window lands in its ring buffer for post-hoc
+    host-wait/device-busy/dispatch-gap attribution and Perfetto export.
+    Recording is one tuple append per event (bench A/B-gates the loop
+    overhead at <= 2%); the default ``None`` skips it entirely.
     """
     ledger = ledger if ledger is not None else NullLedger()
     B = slots
@@ -339,9 +349,31 @@ def run_scheduled(
     rm_buf = np.zeros(B, bool)
     t_loop0 = time.perf_counter()
     gauges.idle_start()  # nothing dispatched yet beyond init
+    d_seq = 0  # run-wide dispatch sequence number (trace attribution key)
+    if trace is not None:
+        trace.begin(t_loop0)
+    # Live-metrics handles: fetched once per run (get-or-create is a dict
+    # lookup); per-chunk updates are a float add under the registry lock,
+    # present in BOTH legs of the bench trace-overhead A/B.
+    _reg = default_registry()
+    m_chunks = _reg.counter(
+        "iat_scheduler_chunks_total", "decode chunks processed")
+    m_refills = _reg.counter(
+        "iat_scheduler_refills_total", "refill/admit dispatches")
+    m_wait = _reg.counter(
+        "iat_scheduler_host_wait_seconds_total",
+        "blocking flag-wait seconds in the host loop")
+    m_occ = _reg.gauge(
+        "iat_scheduler_slot_occupancy",
+        "live-slot fraction at the last processed chunk")
+    m_depth = _reg.gauge(
+        "iat_scheduler_inflight_depth",
+        "dispatches still in flight after the last harvest")
+    m_final = _reg.counter(
+        "iat_scheduler_trials_finalized_total", "trials finalized")
 
     def _dispatch_refill() -> None:
-        nonlocal cache, state, next_trial, refills
+        nonlocal cache, state, next_trial, refills, d_seq
         if faults is not None:
             faults.tick("admission")
         free = np.flatnonzero(slot_trial < 0)
@@ -371,7 +403,12 @@ def run_scheduled(
         # D2H path as the flags — no per-refill host sync.
         flags.copy_to_host_async()
         tok0.copy_to_host_async()
-        pending.append(_InFlight("refill", flags, tok0, slot_trial.copy()))
+        pending.append(_InFlight("refill", flags, tok0, slot_trial.copy(),
+                                 d_seq))
+        if trace is not None:
+            trace.dispatch("refill", d_seq)
+        d_seq += 1
+        m_refills.inc()
         gauges.dispatched(len(pending))
         next_trial += take
         refills += 1
@@ -386,7 +423,7 @@ def run_scheduled(
         window into the Sb window by trimming LEFT padding, so real tokens
         keep their within-window offsets from the right edge — the layout
         scheduler_admit's left-pad restores exactly."""
-        nonlocal next_stage
+        nonlocal next_stage, d_seq
         n = min(stage_group, N - next_stage)
         rows = trials[next_stage : next_stage + n]
         n_real = [int(t.suffix_mask.sum()) for t in rows]
@@ -432,6 +469,9 @@ def run_scheduled(
         # donated live cache, so it is structurally always 0 here.
         overlapped = len(pending) > 0
         sgauges.staged(n, Sb, len(stage_pool) + 1, overlapped)
+        if trace is not None:
+            trace.dispatch("stage", d_seq)
+        d_seq += 1
         stage_pool.append(_StagedGroup(
             qidx=list(range(next_stage, next_stage + n)), n=n, cursor=0,
             sk=sk, sv=sv, smask=smask, spos=spos, tok0=tok0, done0=done0,
@@ -448,7 +488,7 @@ def run_scheduled(
         makes every one an independent "refill"-kind event for
         _process_one). Row→slot assignment walks ascending free slots,
         exactly the sync refill's `free[:take]` mapping."""
-        nonlocal cache, state, next_trial
+        nonlocal cache, state, next_trial, d_seq
         if faults is not None:
             faults.tick("admission")
         free = np.flatnonzero(slot_trial < 0)
@@ -471,7 +511,12 @@ def run_scheduled(
             )
             flags.copy_to_host_async()
             tok0.copy_to_host_async()
-            pending.append(_InFlight("refill", flags, tok0, slot_trial.copy()))
+            pending.append(_InFlight("refill", flags, tok0,
+                                     slot_trial.copy(), d_seq))
+            if trace is not None:
+                trace.dispatch("refill", d_seq)
+            d_seq += 1
+            m_refills.inc()
             gauges.dispatched(len(pending))
             sgauges.admitted()
             grp.cursor += take
@@ -481,7 +526,7 @@ def run_scheduled(
                 stage_pool.popleft()
 
     def _dispatch_chunk() -> None:
-        nonlocal cache, state, g
+        nonlocal cache, state, g, d_seq
         page = jnp.int32(g % n_chunks) if n_chunks else jnp.int32(0)
         cache, state, toks, flags = scheduler_decode_chunk(
             params, cfg, cache, state, spec, page, ch=ch
@@ -489,7 +534,11 @@ def run_scheduled(
         g += 1
         flags.copy_to_host_async()
         toks.copy_to_host_async()
-        pending.append(_InFlight("chunk", flags, toks, slot_trial.copy()))
+        pending.append(_InFlight("chunk", flags, toks, slot_trial.copy(),
+                                 d_seq))
+        if trace is not None:
+            trace.dispatch("chunk", d_seq)
+        d_seq += 1
         gauges.dispatched(len(pending))
         assigned = slot_trial >= 0
         rem[assigned] = np.maximum(rem[assigned] - ch, 0)
@@ -502,6 +551,9 @@ def run_scheduled(
         toks = np.asarray(ev.toks)
         wait_s = time.perf_counter() - t0
         gauges.waited(wait_s)
+        m_wait.inc(wait_s)
+        if trace is not None:
+            trace.landed(ev.kind, ev.seq, t0, t0 + wait_s)
         done = flags[:B] != 0
         n_em = flags[B:]
         if ev.kind == "chunk":
@@ -511,6 +563,8 @@ def run_scheduled(
             occupancy_sum += live / B
             waste_steps += (B - live) * ch
             chunks_done += 1
+            m_chunks.inc()
+            m_occ.set(live / B)
             for s in range(B):
                 ti = int(ev.owners[s])
                 if ti >= 0 and results[ti] is None:
@@ -542,9 +596,13 @@ def run_scheduled(
                 if slot_trial[s] == ti:
                     slot_trial[s] = -1
                     rem[s] = 0
+                m_final.inc()
                 if result_cb is not None:
                     result_cb(ti, results[ti])
         last_done = done
+        m_depth.set(len(pending))
+        if trace is not None:
+            trace.processed(ev.kind, ev.seq)
         if not pending:
             gauges.idle_start()
         if faults is not None and ev.kind == "chunk":
@@ -585,7 +643,10 @@ def run_scheduled(
                 while next_stage < N and _pool_rows() < lookahead_rows:
                     _dispatch_stage()
                 if t_dry is not None:
-                    sgauges.admit_waited(time.perf_counter() - t_dry)
+                    t_wet = time.perf_counter()
+                    sgauges.admit_waited(t_wet - t_dry)
+                    if trace is not None:
+                        trace.stall(t_dry, t_wet)
             if demand and _pool_rows() > 0:
                 _dispatch_admit()
                 # Same reason as the sync refill's `continue`: surface

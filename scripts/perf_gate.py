@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""CI gate over the committed bench trajectory.
+
+Compares a current ``bench.py`` JSON doc against the ``BENCH_r*.json``
+round history with noise-tolerant thresholds (see
+``introspective_awareness_tpu/obs/regress.py``) and exits:
+
+- 0 — verdict ``pass`` / ``improve`` / ``no_history`` (a CPU smoke has
+  no comparable TPU history; that is a pass, not a skip);
+- 1 — verdict ``regress``;
+- 2 — usage / unreadable inputs.
+
+``--inject-regression`` ignores ``--current`` and synthesizes a
+degraded doc from the newest history round itself, so CI can assert the
+regress path fires on any backend. Stdlib-only: ``regress.py`` is
+loaded by file path, so no jax install is needed.
+
+Examples:
+    python scripts/perf_gate.py --current bench_out.json
+    python scripts/perf_gate.py --inject-regression   # must exit 1
+"""
+
+import argparse
+import glob
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_regress():
+    path = os.path.join(
+        _REPO, "introspective_awareness_tpu", "obs", "regress.py"
+    )
+    spec = importlib.util.spec_from_file_location("iat_regress", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--current", default=None,
+                    help="current bench JSON (bench.py stdout doc or a "
+                         "BENCH_r*.json wrapper)")
+    ap.add_argument("--history", nargs="*", default=None,
+                    help="history round files, oldest to newest "
+                         "(default: sorted BENCH_r*.json in the repo root)")
+    ap.add_argument("--tol-scale", type=float, default=1.0,
+                    help="widen every tolerance band by this factor "
+                         "(CI uses >1 on noisy CPU runners)")
+    ap.add_argument("--inject-regression", action="store_true",
+                    help="self-test: gate a synthetically degraded copy of "
+                         "the newest history round (expected exit: 1)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the full gate result JSON to this path")
+    args = ap.parse_args(argv)
+
+    regress = _load_regress()
+    paths = (args.history if args.history
+             else sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json"))))
+    history = []
+    for p in paths:
+        try:
+            doc, n = regress.load_bench_doc(p)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"perf gate: unreadable history file {p}: {e}",
+                  file=sys.stderr)
+            return 2
+        history.append((doc, n if n is not None else os.path.basename(p)))
+    if not history:
+        print("perf gate: no history files found", file=sys.stderr)
+        return 2
+
+    if args.inject_regression:
+        try:
+            current = regress.inject_regression(history)
+        except ValueError as e:
+            print(f"perf gate: {e}", file=sys.stderr)
+            return 2
+    elif args.current:
+        try:
+            current, _ = regress.load_bench_doc(args.current)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"perf gate: unreadable current doc {args.current}: {e}",
+                  file=sys.stderr)
+            return 2
+        if current is None:
+            print("perf gate: current doc has parsed=null (crashed run)",
+                  file=sys.stderr)
+            return 2
+    else:
+        ap.error("one of --current or --inject-regression is required")
+
+    result = regress.compare(current, history, tol_scale=args.tol_scale)
+    print(regress.format_report(result))
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+    return 1 if result["verdict"] == "regress" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,12 +28,17 @@ def _stage_breakdown(runner, cfg, tok, args, ledger) -> None:
 
     Runs the same churny queue (mixed short/long suffixes, 5 short budgets
     per long one) through ``generate_grid_scheduled`` twice — synchronous
-    refill vs staged admission — and attributes each leg's wall clock from
-    the pipeline/staged gauges: host wait, provable device idle, admission
-    stall (``admit_wait_ms``), and the fraction of staged rows whose prefill
-    was dispatched behind an in-flight decode chunk.
+    refill vs staged admission — with a :class:`~introspective_awareness_tpu
+    .obs.ChunkTrace` flight recorder attached to each timed leg. The wall
+    clock attribution (device busy / host wait / dispatch gap / admission
+    stall, per chunk) comes from the shared ``ChunkTrace.summary()`` +
+    ``format_attribution`` path — the same figures the bench JSON and the
+    sweep manifest carry — plus the staged-only gauges (stage/admit counts,
+    suffix buckets, overlap fraction). ``--trace-out`` additionally saves
+    the staged leg's Chrome-trace/Perfetto JSON timeline.
     """
     from bench import _build_workload
+    from introspective_awareness_tpu.obs import ChunkTrace, format_attribution
 
     slots = args.batch
     N = 3 * slots
@@ -51,12 +56,12 @@ def _stage_breakdown(runner, cfg, tok, args, ledger) -> None:
     budgets = [cyc[i % len(cyc)] for i in range(N)]
     layers = [int(cfg.n_layers * 0.6)] * N
 
-    def run(staged):
+    def run(staged, tr=None):
         return runner.generate_grid_scheduled(
             prompts, layers, list(vecs), [4.0] * N, max_new_tokens=max_new,
             temperature=0.0, steering_start_positions=starts,
             budgets=budgets, seed=0, slots=slots, refill_frac=0.5,
-            staged=staged,
+            staged=staged, trace=tr,
         )
 
     def last_span():
@@ -69,32 +74,34 @@ def _stage_breakdown(runner, cfg, tok, args, ledger) -> None:
     legs = {}
     for staged in (False, True):
         run(staged)  # warm/compile this leg
+        tr = ChunkTrace()
         t0 = time.perf_counter()
-        out = run(staged)
-        legs[staged] = (time.perf_counter() - t0, last_span(), out)
+        out = run(staged, tr=tr)
+        legs[staged] = (time.perf_counter() - t0, last_span(), out, tr)
 
-    t_sync, g_sync, o_sync = legs[False]
-    t_staged, g_staged, o_staged = legs[True]
+    t_sync, g_sync, o_sync, tr_sync = legs[False]
+    t_staged, g_staged, o_staged, tr_staged = legs[True]
     print(f"\n== stage breakdown: {N} trials x {slots} slots, "
           f"budgets {cyc} ==")
-    for label, t, g in (("sync refill", t_sync, g_sync),
-                        ("staged admission", t_staged, g_staged)):
+    for label, t, g, tr in (("sync refill", t_sync, g_sync, tr_sync),
+                            ("staged admission", t_staged, g_staged,
+                             tr_staged)):
         print(f"\n  [{label}] wall {t:.2f}s, chunks {g.get('chunks')}, "
               f"refills {g.get('refills')}")
-        print(f"    host_wait_ms   {g.get('host_wait_ms')}")
-        print(f"    device_idle_ms {g.get('device_idle_ms')} "
-              f"(bubble_frac {g.get('bubble_frac')})")
+        print(format_attribution(tr.summary()))
         if label.startswith("staged"):
             print(f"    stages/admits  {g.get('stages')}/{g.get('admits')} "
                   f"(pool high-water {g.get('stage_inflight')})")
-            print(f"    admit_wait_ms  {g.get('admit_wait_ms')} "
-                  f"(stall: demand arrived before staging)")
             print(f"    overlap_frac   {g.get('prefill_overlap_frac')} "
                   f"(rows staged behind an in-flight chunk)")
             print(f"    suffix_buckets {g.get('suffix_buckets')} "
                   f"(vs queue-wide Ss={g.get('suffix_len')})")
     print(f"\n  speedup {t_sync / max(t_staged, 1e-9):.2f}x, "
           f"outputs identical: {o_sync == o_staged}")
+    if args.trace_out:
+        tr_staged.save_perfetto(args.trace_out)
+        print(f"  trace: {args.trace_out} (staged leg; open at "
+              f"https://ui.perfetto.dev)")
 
 
 def main() -> None:
@@ -107,6 +114,10 @@ def main() -> None:
                     help="stream phase-span JSONL here (default: in-memory)")
     ap.add_argument("--hbm-budget-frac", type=float, default=0.9,
                     help="AOT HBM preflight budget fraction; 0 disables")
+    ap.add_argument("--trace-out", default=None,
+                    help="with --stage-breakdown: also save the staged "
+                         "leg's flight-recorder timeline as Chrome-trace/"
+                         "Perfetto JSON here (https://ui.perfetto.dev)")
     ap.add_argument("--stage-breakdown", action="store_true",
                     help="instead of an op trace, A/B the continuous "
                          "scheduler with staged admission off/on over a "

@@ -1,0 +1,266 @@
+"""ChunkTrace flight recorder: ring-buffer bounds, the four-way interval
+attribution (synthetic timelines with hand-computed expected fractions,
+fractions summing to 1.0 on real scheduled runs), multi-session chains,
+Chrome-trace/Perfetto export schema, and the shared text rendering."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.models import (
+    ByteTokenizer,
+    init_params,
+    tiny_config,
+)
+from introspective_awareness_tpu.obs import ChunkTrace, format_attribution
+from introspective_awareness_tpu.runtime import ModelRunner
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def runner(setup):
+    cfg, params = setup
+    return ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4,
+    )
+
+
+COMMON = "The quick brown fox jumps over the lazy dog. " * 4
+
+
+def _queue(n, hidden):
+    prompts, starts, strengths, layers = [], [], [], []
+    for i in range(n):
+        p = (
+            COMMON
+            + f"Trial {i + 1}: Do you detect an injected thought"
+            + "?" * (i % 3 + 1)
+        )
+        prompts.append(p)
+        if i % 3 == 2:
+            strengths.append(0.0)
+            starts.append(None)
+        else:
+            strengths.append(6.0 + i)
+            starts.append(len(p) - 10)
+        layers.append(1 + i % 2)
+    rng = np.random.default_rng(7)
+    vecs = [rng.standard_normal(hidden).astype(np.float32) * 4.0
+            for _ in range(n)]
+    return prompts, layers, vecs, strengths, starts
+
+
+def _synthetic(tr, events):
+    """Append raw event tuples, bypassing the wall clock."""
+    for tup in events:
+        tr._ev.append(tup)
+        tr.n_recorded += 1
+
+
+class TestRingBuffer:
+    def test_capacity_floor(self):
+        assert ChunkTrace(capacity=1).capacity == 16
+        assert ChunkTrace(capacity=-5).capacity == 16
+        assert ChunkTrace(capacity=100).capacity == 100
+
+    def test_overflow_drops_oldest_and_counts(self):
+        tr = ChunkTrace(capacity=32)
+        for i in range(100):
+            tr.dispatch("chunk", i)
+        assert len(tr) == 32
+        assert tr.n_recorded == 100
+        assert tr.dropped == 68
+        # the survivors are the NEWEST 32 events
+        assert [e[2] for e in tr.events()] == list(range(68, 100))
+
+    def test_empty_trace_is_benign(self):
+        tr = ChunkTrace()
+        assert len(tr) == 0
+        assert tr.dropped == 0
+        assert tr.attribution() == []
+        s = tr.summary()
+        assert s["chunks"] == 0 and s["fractions_sum"] is None
+        assert tr.to_perfetto() == {"traceEvents": [], "displayTimeUnit": "ms"}
+
+
+class TestAttribution:
+    def test_synthetic_timeline_exact_fractions(self):
+        """Hand-built chain: gap 0.1s -> wait 0.3s -> busy 0.6s for the
+        first chunk; then a 0.2s stall, wait 0.2s, busy 0.6s for the
+        refill. Attribution must recover those splits exactly."""
+        tr = ChunkTrace()
+        _synthetic(tr, [
+            ("beg", None, 0, 0.0, 0.0),
+            ("disp", "chunk", 0, 0.1, 0.0),     # 0.1s dispatch gap
+            ("land", "chunk", 0, 0.5, 0.8),     # 0.3s host wait
+            ("proc", "chunk", 0, 1.0, 0.0),     # interval [0.0, 1.0]
+            ("stall", None, 0, 1.0, 1.2),       # 0.2s admission stall
+            ("disp", "refill", 1, 1.2, 0.0),    # gap fully covered by stall
+            ("land", "refill", 1, 1.3, 1.5),    # 0.2s host wait
+            ("proc", "refill", 1, 2.0, 0.0),    # interval [1.0, 2.0]
+        ])
+        rows = tr.attribution()
+        assert [r["kind"] for r in rows] == ["chunk", "refill"]
+
+        c = rows[0]
+        assert c["interval_s"] == pytest.approx(1.0)
+        assert c["dispatch_gap_frac"] == pytest.approx(0.1, abs=1e-4)
+        assert c["host_wait_frac"] == pytest.approx(0.3, abs=1e-4)
+        assert c["device_busy_frac"] == pytest.approx(0.6, abs=1e-4)
+        assert c["admission_stall_frac"] == 0.0
+
+        r = rows[1]
+        assert r["admission_stall_frac"] == pytest.approx(0.2, abs=1e-4)
+        assert r["host_wait_frac"] == pytest.approx(0.2, abs=1e-4)
+        assert r["dispatch_gap_frac"] == 0.0  # stall ate the whole gap
+        assert r["device_busy_frac"] == pytest.approx(0.6, abs=1e-4)
+
+        s = tr.summary()
+        assert s["chunks"] == 1 and s["refills"] == 1
+        assert s["attributed_s"] == pytest.approx(2.0)
+        assert s["fractions_sum"] == pytest.approx(1.0, abs=2e-3)
+
+    def test_fractions_sum_to_one_even_with_overlapping_windows(self):
+        """Pathological overlap (wait + stall + gap exceed the interval)
+        must rescale, never produce negative busy or a sum != 1."""
+        tr = ChunkTrace()
+        _synthetic(tr, [
+            ("beg", None, 0, 0.0, 0.0),
+            ("stall", None, 0, 0.0, 0.9),
+            ("disp", "chunk", 0, 0.9, 0.0),
+            ("land", "chunk", 0, 0.0, 0.95),  # overlaps the stall window
+            ("proc", "chunk", 0, 1.0, 0.0),
+        ])
+        (row,) = tr.attribution()
+        fracs = [row[k] for k in ("host_wait_frac", "device_busy_frac",
+                                  "dispatch_gap_frac", "admission_stall_frac")]
+        assert all(f >= 0.0 for f in fracs)
+        assert sum(fracs) == pytest.approx(1.0, abs=2e-3)
+
+    def test_multi_session_begin_resets_chain(self):
+        """A trace fed by several run_scheduled calls: every session's
+        chunks are attributed and the idle gap between sessions is NOT
+        booked against the next session's first chunk."""
+        tr = ChunkTrace()
+        for base in (0.0, 100.0):  # two sessions, 100s of idle between
+            _synthetic(tr, [
+                ("beg", None, 0, base, 0.0),
+                ("disp", "chunk", int(base), base + 0.1, 0.0),
+                ("land", "chunk", int(base), base + 0.4, base + 0.5),
+                ("proc", "chunk", int(base), base + 1.0, 0.0),
+            ])
+        rows = tr.attribution()
+        assert len(rows) == 2
+        for r in rows:
+            assert r["interval_s"] == pytest.approx(1.0)
+            assert r["host_wait_frac"] == pytest.approx(0.1, abs=1e-4)
+        assert tr.summary()["chunks"] == 2
+        assert tr.summary()["attributed_s"] == pytest.approx(2.0)
+
+    def test_real_scheduled_run_attributes_everything(self, runner):
+        """Live pipelined run on the tiny model: chunks and refills are
+        recorded, per-row fractions each sum to ~1.0, and recording does
+        not perturb the decoded text."""
+        N = 8
+        prompts, layers, vecs, strengths, starts = _queue(
+            N, runner.cfg.hidden_size)
+        kw = dict(
+            max_new_tokens=12, temperature=0.0,
+            steering_start_positions=starts, slots=4, pipeline=True, seed=0,
+        )
+        bare = runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, **kw)
+        tr = ChunkTrace()
+        traced = runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, trace=tr, **kw)
+        assert traced == bare, "recording perturbed decode output"
+
+        s = tr.summary()
+        assert s["chunks"] > 0
+        assert s["refills"] > 0
+        assert s["dropped"] == 0
+        assert s["fractions_sum"] == pytest.approx(1.0, abs=5e-3)
+        for row in tr.attribution():
+            fracs = (row["host_wait_frac"] + row["device_busy_frac"]
+                     + row["dispatch_gap_frac"] + row["admission_stall_frac"])
+            assert fracs == pytest.approx(1.0, abs=5e-3)
+            assert row["interval_s"] > 0
+
+
+class TestPerfetto:
+    def test_schema_and_roundtrip(self, tmp_path):
+        tr = ChunkTrace()
+        _synthetic(tr, [
+            ("beg", None, 0, 0.0, 0.0),
+            ("disp", "chunk", 0, 0.1, 0.0),
+            ("land", "chunk", 0, 0.5, 0.8),
+            ("proc", "chunk", 0, 1.0, 0.0),
+            ("stall", None, 0, 1.0, 1.2),
+            ("gsub", None, 3, 1.3, 0.0),
+            ("gret", None, 2, 1.4, 1.9),
+        ])
+        doc = tr.to_perfetto()
+        assert set(doc) == {"traceEvents", "displayTimeUnit"}
+        evs = doc["traceEvents"]
+
+        metas = [e for e in evs if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in metas
+                if m["name"] == "process_name"} == {"scheduler", "grading"}
+        assert "device in-flight" in {m["args"]["name"] for m in metas
+                                      if m["name"] == "thread_name"}
+
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert xs, "no duration events"
+        for x in xs:
+            assert x["dur"] > 0 and x["ts"] >= 0
+        # grading lands on its own process
+        assert any(x["pid"] == 2 for x in xs)
+        assert any(e["ph"] == "i" and e["pid"] == 2 for e in evs)
+
+        path = tr.save_perfetto(str(tmp_path / "trace.json"))
+        with open(path, encoding="utf-8") as f:
+            assert json.load(f) == doc
+
+    def test_real_run_exports_nonempty_trace(self, runner, tmp_path):
+        prompts, layers, vecs, strengths, starts = _queue(
+            4, runner.cfg.hidden_size)
+        tr = ChunkTrace()
+        runner.generate_grid_scheduled(
+            prompts, layers, vecs, strengths,
+            max_new_tokens=8, temperature=0.0,
+            steering_start_positions=starts, slots=2, pipeline=True,
+            seed=0, trace=tr,
+        )
+        path = tr.save_perfetto(str(tmp_path / "real.json"))
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        assert len(doc["traceEvents"]) > 4
+
+
+class TestFormatAttribution:
+    def test_empty(self):
+        assert format_attribution({}) == "  trace: no chunks recorded"
+        assert format_attribution(ChunkTrace().summary()) == \
+            "  trace: no chunks recorded"
+
+    def test_renders_counts_and_percents(self):
+        tr = ChunkTrace()
+        _synthetic(tr, [
+            ("beg", None, 0, 0.0, 0.0),
+            ("disp", "chunk", 0, 0.1, 0.0),
+            ("land", "chunk", 0, 0.5, 0.8),
+            ("proc", "chunk", 0, 1.0, 0.0),
+        ])
+        text = format_attribution(tr.summary())
+        assert "1 chunks, 0 refills" in text
+        assert "device_busy" in text and "%" in text
+        assert "dropped" not in text  # nothing dropped -> no suffix
