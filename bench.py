@@ -753,6 +753,96 @@ def _durability_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     return r
 
 
+def _fabric_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
+    """1 vs 2 emulated sweep-fabric replicas on an admission-heavy queue.
+
+    Both replicas are ModelRunners over the SAME weights (no extra
+    parameter HBM beyond each replica's own KV/activation working set —
+    which is what the HBM gate meters). The queue is admission-heavy by
+    construction: 4 decode cohorts' worth of short trials, so the
+    partitioned queue, lease churn, and work stealing all exercise. The
+    headline claims are ``outputs_identical`` (trial PRNG streams keyed by
+    global queue index — the fabric's bit-identity invariant, checked at
+    temperature 1) and the fleet gauges: aggregate evals/s, steal count,
+    mean replica idle fraction. ``speedup`` is wall-clock 1-replica over
+    2-replica; replicas here time-share the same device(s), so it measures
+    scheduling overhead off-TPU, not pod-scale throughput — the
+    replica-scaling trajectory in BENCH history is what perf_gate watches.
+    """
+    import time as _time
+
+    from introspective_awareness_tpu.fabric import SweepFabric
+    from introspective_awareness_tpu.obs.registry import MetricsRegistry
+    from introspective_awareness_tpu.protocol.trials import run_grid_pass
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    replicas = [
+        ModelRunner(
+            runner.params, cfg, tok, model_name=f"bench-fabric-r{k}",
+            seq_multiple=16, batch_multiple=slots,
+            ledger=ledger if k == 0 else None,
+        )
+        for k in range(2)
+    ]
+    rng = np.random.default_rng(9)
+    concepts = ("Dust", "Trees")
+    n_per = max(1, 2 * slots)  # 2 concepts x 2*slots trials = 4 cohorts
+    layer_idx = int(cfg.n_layers * 0.6)
+    tasks = [
+        (c, t, 0.6, layer_idx, 4.0)
+        for c in concepts for t in range(1, n_per + 1)
+    ]
+    vecs = {
+        c: rng.normal(size=cfg.hidden_size).astype(np.float32)
+        for c in concepts
+    }
+    kw = dict(
+        max_new_tokens=max_new, temperature=1.0, batch_size=slots,
+        seed=23, scheduler="continuous",
+    )
+
+    def run(engine_runner, **extra):
+        return run_grid_pass(
+            engine_runner, "injection", tasks, lambda lf, c: vecs[c],
+            **kw, **extra,
+        )
+
+    for r in replicas:  # warm both compiles out of the timed region
+        run(r)
+    t0 = _time.perf_counter()
+    ref = run(replicas[0])
+    t_one = _time.perf_counter() - t0
+
+    fab = SweepFabric(replicas, registry=MetricsRegistry())
+    t0 = _time.perf_counter()
+    out = run(replicas[0], fabric=fab)
+    t_two = _time.perf_counter() - t0
+    fs = fab.last_stats
+
+    r = {
+        "queue_trials": len(tasks),
+        "slots": slots,
+        "n_replicas": 2,
+        "outputs_identical": out == ref,
+        "one_replica_time_s": round(t_one, 3),
+        "two_replica_time_s": round(t_two, 3),
+        "speedup": round(t_one / t_two, 3) if t_two > 0 else None,
+        "aggregate_evals_per_s": fs.get("aggregate_evals_per_s"),
+        "steals": fs.get("steals"),
+        "stolen_trials": fs.get("stolen_trials"),
+        "peak_queue_skew": fs.get("peak_queue_skew"),
+        "replica_idle_frac_mean": fs.get("replica_idle_frac_mean"),
+        "leases": fs.get("leases"),
+    }
+    log(
+        f"  [fabric] {len(tasks)} trials x {slots} slots: 1 replica "
+        f"{t_one:.2f}s vs 2 replicas {t_two:.2f}s -> {r['speedup']}x, "
+        f"identical={r['outputs_identical']}, steals={r['steals']}, "
+        f"idle={r['replica_idle_frac_mean']}"
+    )
+    return r
+
+
 def _hbm_model(runner, cfg, batch, prompt_len, max_new,
                batch_chunk=None, suffix_chunk=None) -> dict:
     """Modeled HBM bytes for the best config, chunk-plan aware.
@@ -1105,6 +1195,14 @@ def main() -> None:
         ledger,
     )
 
+    # ---- sweep fabric: 1 vs 2 emulated replicas, identity + fleet gauges ---
+    fab = _gated(
+        "fabric",
+        lambda: _fabric_compare(runner, cfg, tok, batches[0], max_new,
+                                ledger),
+        ledger,
+    )
+
     # ---- chunked large-batch prefill: equivalence + AOT memory + autotune --
     pmem = _gated(
         "prefill_memory",
@@ -1374,6 +1472,7 @@ def main() -> None:
         "pipeline": pipe,
         "staged_prefill": stg,
         "durability": dur,
+        "fabric": fab,
         "prefill_memory": pmem,
         "trace": trace_block,
         "backend": backend,

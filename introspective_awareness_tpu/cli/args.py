@@ -114,6 +114,24 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Use only the first N visible devices (default: "
                              "all) — e.g. pin a sweep to a sub-mesh while "
                              "another job holds the rest")
+    parser.add_argument("--fabric-replicas", type=int, default=1,
+                        help="Sweep fabric: run N data-parallel model "
+                             "replicas (each its own dp*tp*... sub-mesh from "
+                             "the visible devices) draining one partitioned "
+                             "trial queue with work stealing. Requires "
+                             "--scheduler continuous. Outputs are "
+                             "bit-identical to --fabric-replicas 1 (greedy "
+                             "and sampled): PRNG streams are keyed by global "
+                             "queue index, not by replica. Each replica "
+                             "journals to trial_journal.replica<k>.jsonl; "
+                             "resume merges all replica journals and works "
+                             "with any replica count. CPU emulation: "
+                             "XLA_FLAGS=--xla_force_host_platform_"
+                             "device_count=8.")
+    parser.add_argument("--fabric-lease", type=int, default=0,
+                        help="Trials per work-stealing lease (fabric queue "
+                             "granularity). 0 = auto: one slot-batch "
+                             "(--batch-size) per lease.")
     parser.add_argument("--judge-backend", type=str, default="openai",
                         choices=["openai", "on-device", "none"],
                         help="openai = API judge (reference behavior); "

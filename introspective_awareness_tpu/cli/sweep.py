@@ -187,6 +187,61 @@ def load_subject(name: str, args, mesh, rules):
     ))
 
 
+def _build_fabric(args, model_name: str, runner, mesh, rules):
+    """``--fabric-replicas N>1`` → a SweepFabric over the primary runner
+    plus N-1 freshly-loaded replicas; None otherwise.
+
+    Device placement: when the visible devices hold N disjoint copies of
+    the primary mesh shape, replica k runs on devices ``[k*per, (k+1)*per)``
+    as its own sub-mesh — true data parallelism (CPU emulation via
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``). Otherwise
+    every replica shares the primary mesh: thread-level concurrency only,
+    but outputs stay bit-identical either way because trial PRNG streams
+    are keyed by global queue index, never by placement.
+    """
+    n = int(getattr(args, "fabric_replicas", 1) or 1)
+    if n <= 1:
+        return None
+    import jax
+
+    from introspective_awareness_tpu.fabric import FabricJournalSet, SweepFabric
+    from introspective_awareness_tpu.parallel import MeshConfig, build_mesh
+
+    per = int(mesh.devices.size) if mesh is not None else 1
+    base = jax.devices()
+    disjoint = mesh is not None and len(base) >= n * per
+    runners = [runner]
+    for k in range(1, n):
+        if disjoint:
+            rmesh = build_mesh(
+                MeshConfig(dp=args.dp, tp=args.tp, ep=args.ep,
+                           sp=args.sp, pp=args.pp),
+                devices=base[k * per:(k + 1) * per],
+            )
+        else:
+            rmesh = mesh
+        r = load_subject(model_name, args, rmesh, rules)
+        # Extra replicas keep the default NullLedger (RunLedger is not
+        # thread-safe); the fabric reports fleet stats via the primary's.
+        r.hbm_budget_frac = args.hbm_budget_frac
+        r.prefill_batch_chunk = getattr(args, "prefill_batch_chunk", None)
+        r.prefill_suffix_chunk = getattr(args, "prefill_suffix_chunk", None)
+        runners.append(r)
+    journal = getattr(args, "_journal", None)
+    fabric = SweepFabric(
+        runners,
+        lease_size=int(getattr(args, "fabric_lease", 0) or 0),
+        ledger=getattr(args, "_ledger", None),
+        journals=journal if isinstance(journal, FabricJournalSet) else None,
+        progress=getattr(args, "_progress", None),
+    )
+    print(
+        f"  fabric: {n} replicas x {per} device(s) each "
+        f"({'disjoint sub-meshes' if disjoint else 'shared mesh'})"
+    )
+    return fabric
+
+
 def _journal_config(args, model_name: str) -> dict:
     """The grid-identity signature stamped into the journal's start record.
 
@@ -226,10 +281,35 @@ def _open_journal(args, model_name: str):
         )
     else:
         path = Path(args.journal)
-    if args.overwrite and path.exists():
-        path.unlink()
+    from introspective_awareness_tpu.fabric import FabricJournalSet
+
+    n_fabric = int(getattr(args, "fabric_replicas", 1) or 1)
+    replica_files = FabricJournalSet.discover(path)
+    if args.overwrite:
+        for p in (path, *replica_files):
+            if p.exists():
+                p.unlink()
+        replica_files = []
     t0 = time.perf_counter()
-    journal = TrialJournal(path, _journal_config(args, model_name))
+    if n_fabric > 1 or replica_files:
+        # Fabric journal set: one file per replica, merged on replay. Also
+        # taken at --fabric-replicas 1 when a previous fabric run left
+        # replica journals behind — resuming with a different replica count
+        # (including one) replays the merged state bit-identically.
+        if path.exists():
+            # Adopt a plain single-replica journal from a previous run into
+            # the replica namespace so merged replay includes it too.
+            adopted = FabricJournalSet.replica_path(path, "prev")
+            k = 0
+            while adopted.exists():
+                k += 1
+                adopted = FabricJournalSet.replica_path(path, f"prev{k}")
+            path.rename(adopted)
+        journal = FabricJournalSet(
+            path, _journal_config(args, model_name), n_replicas=n_fabric
+        )
+    else:
+        journal = TrialJournal(path, _journal_config(args, model_name))
     if journal.resumed:
         # Rotate the replayed journal down to live state before appending
         # this run's records on top.
@@ -302,6 +382,7 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
     breaker = getattr(args, "_judge_breaker", None)
     trace = getattr(args, "_trace", None)
     progress = getattr(args, "_progress", None)
+    fabric = getattr(args, "_fabric", None)
 
     # ---- vectors for every swept layer, one capture pass ------------------
     t0 = time.perf_counter()
@@ -447,8 +528,10 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 grade_pool=_make_pool(pass_key),
                 journal=journal, pass_key=pass_key,
                 stop_event=stop_event, faults=faults, trace=trace,
+                fabric=fabric,
             )
-            if progress is not None:
+            if progress is not None and fabric is None:
+                # The fabric's per-replica trackers already counted these.
                 progress.add_done(len(out))
             fused += out
             # Pass-granular timings: the fused grid has no per-cell unit of
@@ -508,10 +591,11 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                     grade_pool=_make_pool(pass_key),
                     journal=journal, pass_key=pass_key,
                     stop_event=stop_event, faults=faults, trace=trace,
+                    fabric=fabric,
                     **common,
                 )
                 results += out
-                if progress is not None:
+                if progress is not None and fabric is None:
                     progress.add_done(len(out))
             t_cell = time.perf_counter() - t0
             t_gen += t_cell
@@ -890,6 +974,14 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     args = parse_args(argv)
 
+    if getattr(args, "fabric_replicas", 1) > 1 and args.scheduler != "continuous":
+        print(
+            "error: --fabric-replicas requires --scheduler continuous (the "
+            "fabric leases per-trial work; the batch scheduler has no "
+            "per-trial granularity to partition or steal)"
+        )
+        return 2
+
     # Fault injection (test/CI harness only): --inject-faults wins over the
     # IAT_FAULTS env var; both absent → None (zero overhead on hot paths).
     args._faults = (
@@ -1004,9 +1096,9 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     # ---- live telemetry plane (--metrics-port / --trace-out) --------------
     from introspective_awareness_tpu.obs import (
+        AggregateProgress,
         ChunkTrace,
         MetricsServer,
-        ProgressTracker,
     )
 
     args._trace = None
@@ -1018,7 +1110,9 @@ def main(argv: Optional[list[str]] = None) -> int:
             )
         else:
             args._trace = ChunkTrace()
-    args._progress = progress = ProgressTracker()
+    # AggregateProgress degenerates to a plain tracker until a fabric
+    # replica registers, so /progress is fleet-aware without a mode switch.
+    args._progress = progress = AggregateProgress()
     progress.set_extra(models=models, output_dir=args.output_dir)
     if args._judge_breaker is not None:
         breaker = args._judge_breaker
@@ -1117,10 +1211,17 @@ def _run_models(args, models, judge, ledger, mesh, rules) -> int:
                 args, "prefill_batch_chunk", None)
             runner.prefill_suffix_chunk = getattr(
                 args, "prefill_suffix_chunk", None)
+            args._fabric = None
+            if getattr(args, "fabric_replicas", 1) > 1:
+                with ledger.span("load", model=model_name, what="fabric_replicas"):
+                    args._fabric = _build_fabric(
+                        args, model_name, runner, mesh, rules
+                    )
             try:
                 with profile_trace(args.profile_dir):
                     all_results = run_sweep(args, runner, judge, model_name)
             except SweepInterrupted as e:
+                args._fabric = None
                 journal = args._journal
                 if journal is not None:
                     journal.record_clean_stop()
@@ -1136,6 +1237,9 @@ def _run_models(args, models, judge, ledger, mesh, rules) -> int:
                     )
                 return 130
             write_debug_dumps(out_base, runner, args, all_results)
+            if getattr(args, "_fabric", None) is not None:
+                args._fabric.cleanup()
+                args._fabric = None
             runner.cleanup()
             args._journal = None
 

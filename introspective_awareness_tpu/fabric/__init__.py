@@ -1,0 +1,23 @@
+"""Sweep fabric: multi-replica data-parallel sweep execution.
+
+N model replicas — each a ModelRunner + continuous slot scheduler over
+its own device subset — drain one partitioned global trial queue with
+lease-based work stealing, while per-replica trial journals merge into a
+single bit-identical, resumable result set. See ``fabric.py`` for the
+determinism argument and README "Sweep fabric" for the operator view.
+"""
+
+from .fabric import SweepFabric
+from .journal import FabricJournalSet
+from .queue import PartitionedTrialQueue, QueueStats, WorkLease
+from .worker import ReplicaStats, ReplicaWorker
+
+__all__ = [
+    "FabricJournalSet",
+    "PartitionedTrialQueue",
+    "QueueStats",
+    "ReplicaStats",
+    "ReplicaWorker",
+    "SweepFabric",
+    "WorkLease",
+]

@@ -1,0 +1,303 @@
+"""SweepFabric: N replica runners draining one global trial queue.
+
+The fabric presents the SAME ``generate_grid_scheduled`` surface as a
+single :class:`~introspective_awareness_tpu.runtime.runner.ModelRunner`,
+so ``run_grid_pass`` swaps engines without knowing about replicas. Each
+worker thread leases blocks of queue positions from the partitioned
+queue (:mod:`.queue`), decodes them through its own runner + slot
+scheduler, and steals from the most-loaded partition when its own runs
+dry.
+
+Bit-identity: every trial's PRNG stream is keyed by its GLOBAL queue
+index (the scheduler's ``trial_ids``), and a trial's decode depends only
+on (seed, stream id, trial content) — never on which replica ran it,
+when, or alongside what. Partitioning and stealing only move indices
+between workers, so 2- or 4-replica output is bit-identical to the
+single-replica run, greedy and sampled — the same property the journal
+resume path relies on for subsets. (Caveat shared with resume: prompt
+sets with no common token prefix fall back to the fixed-batch path,
+which does not carry ``trial_ids``; sweep trial prompts always share a
+prefix, and the runner ledgers the fallback if it ever fires.)
+
+Crash semantics match the single-replica scheduler: the first worker
+error aborts the fleet and re-raises after join (``InjectedCrash``
+propagates; a graceful stop re-raises ``SweepInterrupted`` so callers
+flush journals). With a :class:`~.journal.FabricJournalSet` attached,
+each worker's thread binds its replica id so finalized trials land in
+that replica's journal file; merged replay makes kill-one-worker resume
+bit-identical as well.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+from introspective_awareness_tpu.obs.registry import default_registry
+from introspective_awareness_tpu.runtime.journal import SweepInterrupted
+
+from .journal import FabricJournalSet
+from .queue import PartitionedTrialQueue
+from .worker import ReplicaWorker
+
+
+class SweepFabric:
+    """Drives ``runners`` (replica 0 first — usually the primary, whose
+    ledger/trace the sweep owns) as data-parallel sweep replicas.
+
+    ``lease_size=0`` auto-sizes leases to one slot-batch per acquire.
+    ``partitions`` pins an explicit initial split of queue positions for
+    every pass (tests use a fully-skewed split to force steals);
+    production leaves it None for the contiguous even split.
+    """
+
+    def __init__(
+        self,
+        runners: Sequence,
+        *,
+        lease_size: int = 0,
+        ledger=None,
+        journals: Optional[FabricJournalSet] = None,
+        progress=None,
+        registry=None,
+        partitions: Optional[Sequence[Sequence[int]]] = None,
+    ) -> None:
+        if not runners:
+            raise ValueError("fabric needs at least one runner")
+        self.workers = [ReplicaWorker(k, r) for k, r in enumerate(runners)]
+        self.lease_size = max(0, int(lease_size))
+        self.ledger = ledger
+        self.journals = journals
+        self.progress = progress
+        self.partitions = partitions
+        self.last_stats: dict = {}
+        self._passes = 0
+
+        reg = registry if registry is not None else default_registry()
+        labels = [str(k) for k in range(len(self.workers))]
+        # Reserve the replica label values so high-cardinality labels
+        # elsewhere can never overflow fabric series into "other".
+        reg.reserve_label_values("replica", labels)
+        rl = ("replica",)
+        self._m_steals = reg.counter(
+            "iat_fabric_steals_total",
+            "work-stealing leases served from a foreign partition",
+            labelnames=rl,
+        )
+        self._m_trials = reg.counter(
+            "iat_fabric_trials_total",
+            "trials decoded by each fabric replica",
+            labelnames=rl,
+        )
+        self._m_idle = reg.gauge(
+            "iat_fabric_replica_idle_frac",
+            "fraction of the last pass each replica spent without a lease",
+            labelnames=rl,
+        )
+        self._m_skew = reg.gauge(
+            "iat_fabric_queue_skew",
+            "peak max-min partition backlog observed in the last pass",
+        )
+
+    # -- runner-compatible surface ------------------------------------------
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.workers)
+
+    @property
+    def ledger_owner(self):
+        return self.workers[0].runner
+
+    def cleanup(self) -> None:
+        """Drop non-primary replica references. Deliberately does NOT call
+        each runner's ``cleanup()``: that clears process-global jax caches,
+        which would also evict the primary's live executables."""
+        for w in self.workers[1:]:
+            w.runner = None
+        del self.workers[1:]
+
+    def generate_grid_scheduled(
+        self,
+        prompts: Sequence[str],
+        *,
+        layer_indices: Sequence[int],
+        steering_vectors: Sequence,
+        strengths: Sequence[float],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        steering_start_positions: Optional[Sequence] = None,
+        seed: Optional[int] = None,
+        slots: int = 8,
+        staged=None,
+        result_cb=None,
+        trial_ids: Optional[Sequence[int]] = None,
+        stop_event=None,
+        faults=None,
+        trace=None,
+        partitions: Optional[Sequence[Sequence[int]]] = None,
+    ) -> list[str]:
+        """Drain one grid pass through all replicas. Same contract as the
+        runner method; ``trial_ids`` are the GLOBAL stream ids (callers that
+        pass None get ``range(N)`` — the uninterrupted single-queue ids)."""
+        N = len(prompts)
+        if N == 0:
+            return []
+        if seed is None:
+            # The runner auto-derives a per-call seed from its call counter,
+            # which replicas cannot share — identity across replica counts
+            # requires the caller to pin the stream base explicitly.
+            raise ValueError(
+                "SweepFabric requires an explicit seed: the runner's "
+                "auto-seed is per-runner call-counter state and would "
+                "diverge across replica counts"
+            )
+        ids = list(trial_ids) if trial_ids is not None else list(range(N))
+        if len(ids) != N:
+            raise ValueError(f"{len(ids)} trial_ids for {N} prompts")
+
+        R = self.n_replicas
+        lease = self.lease_size or max(1, int(slots))
+        queue = PartitionedTrialQueue(
+            N, R, lease_size=lease,
+            partitions=partitions if partitions is not None else self.partitions,
+        )
+        out: list[Optional[str]] = [None] * N
+        abort = threading.Event()
+        cb_lock = threading.Lock()
+        starts = steering_start_positions
+        self._passes += 1
+
+        def decode(worker: ReplicaWorker, lease_obj) -> None:
+            if self.journals is not None:
+                self.journals.bind_replica(worker.replica_id)
+            tracker = None
+            if self.progress is not None:
+                tracker = self.progress.replica(str(worker.replica_id))
+                tracker.set_phase(
+                    f"decode/pass{self._passes}/lease{lease_obj.lease_id}"
+                )
+            sub = lease_obj.indices
+
+            def cb(j: int, text: str) -> None:
+                p = sub[j]
+                out[p] = text
+                if tracker is not None:
+                    tracker.add_done(1)
+                if result_cb is not None:
+                    with cb_lock:
+                        result_cb(p, text)
+
+            texts = worker.runner.generate_grid_scheduled(
+                [prompts[p] for p in sub],
+                layer_indices=[layer_indices[p] for p in sub],
+                steering_vectors=[steering_vectors[p] for p in sub],
+                strengths=[strengths[p] for p in sub],
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                steering_start_positions=(
+                    None if starts is None else [starts[p] for p in sub]
+                ),
+                seed=seed,
+                slots=slots,
+                staged=staged,
+                result_cb=cb,
+                trial_ids=[ids[p] for p in sub],
+                stop_event=stop_event,
+                faults=self._faults_for(faults, worker.replica_id),
+                # The flight recorder is not replica-aware; replica 0 keeps
+                # the timeline, others decode untraced.
+                trace=trace if worker.replica_id == 0 else None,
+            )
+            for j, p in enumerate(sub):
+                out[p] = texts[j]
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(
+                target=w.drain, args=(queue, decode, abort),
+                name=f"fabric-replica-{w.replica_id}", daemon=True,
+            )
+            for w in self.workers
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+
+        self._finish_stats(queue, elapsed, N)
+
+        hard = [w.error for w in self.workers
+                if w.error is not None and not w.interrupted]
+        if hard:
+            raise hard[0]
+        for w in self.workers:
+            if w.interrupted:
+                raise w.error if isinstance(w.error, SweepInterrupted) else (
+                    SweepInterrupted("fabric sweep stopped")
+                )
+        missing = sum(1 for r in out if r is None)
+        if missing:
+            raise RuntimeError(
+                f"fabric pass lost {missing}/{N} trials without any worker "
+                f"error — lease accounting bug"
+            )
+        return out  # type: ignore[return-value]
+
+    # -- internals -----------------------------------------------------------
+
+    @staticmethod
+    def _faults_for(faults, replica_id: int):
+        """A fault plan with ``kill_replica`` set only afflicts that
+        replica; untargeted plans hit every replica (shared counters, so
+        e.g. crash_after_chunks fires once, fleet-wide)."""
+        if faults is None:
+            return None
+        target = getattr(faults, "kill_replica", None)
+        if target is not None and int(target) != replica_id:
+            return None
+        return faults
+
+    def _finish_stats(self, queue: PartitionedTrialQueue,
+                      elapsed: float, n_trials: int) -> None:
+        qs = queue.stats.as_stats()
+        replicas = {}
+        for w in self.workers:
+            idle = (
+                max(0.0, 1.0 - w.stats.busy_s / elapsed) if elapsed > 0
+                else 0.0
+            )
+            replicas[str(w.replica_id)] = {
+                **w.stats.as_stats(), "idle_frac": round(idle, 4),
+            }
+            self._m_trials.inc(w.stats.trials, replica=str(w.replica_id))
+            self._m_steals.inc(
+                w.stats.stolen_leases, replica=str(w.replica_id)
+            )
+            self._m_idle.set(idle, replica=str(w.replica_id))
+            # Per-pass counters: reset so the next pass re-accumulates.
+            w.stats.trials = w.stats.leases = w.stats.stolen_leases = 0
+            w.stats.busy_s = 0.0
+        self._m_skew.set(qs["peak_queue_skew"])
+        idle_fracs = [r["idle_frac"] for r in replicas.values()]
+        self.last_stats = {
+            **qs,
+            "replicas": replicas,
+            "n_replicas": self.n_replicas,
+            "trials": n_trials,
+            "elapsed_s": round(elapsed, 4),
+            "aggregate_evals_per_s": (
+                round(n_trials / elapsed, 4) if elapsed > 0 else 0.0
+            ),
+            "replica_idle_frac_mean": (
+                round(sum(idle_fracs) / len(idle_fracs), 4)
+                if idle_fracs else 0.0
+            ),
+        }
+        if self.ledger is not None:
+            # Coordinator thread only — RunLedger is not thread-safe.
+            flat = {k: v for k, v in self.last_stats.items()
+                    if k != "replicas"}
+            self.ledger.event("fabric_pass", **flat)

@@ -1,0 +1,169 @@
+"""Per-replica trial journals with merged replay.
+
+Each fabric replica appends to its OWN :class:`TrialJournal`
+(``trial_journal.replica<k>.jsonl``) so the decode hot path never
+serializes two replicas through one file lock or fsync stream. Because
+records are keyed by trial identity — not by queue position or replica —
+the union of all replica journals IS the single-replica journal's state:
+replay merges every file and the protocol layer resumes exactly as it
+would from one journal. Resuming with a different replica count (including
+one) is therefore safe and bit-identical; journals left by extra replicas
+of a previous run are discovered and merged too.
+
+:class:`FabricJournalSet` mirrors the TrialJournal API that the protocol
+and CLI layers consume, plus ``bind_replica`` — worker threads bind their
+replica id thread-locally so ``record_*`` lands in their own file (threads
+that never bind, e.g. grade-pool workers, write to replica 0; harmless,
+identity keys merge regardless of which file holds a record).
+"""
+
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+from typing import Optional
+
+from introspective_awareness_tpu.obs.recovery import RecoveryGauges
+from introspective_awareness_tpu.runtime.journal import TrialJournal
+
+
+class FabricJournalSet:
+    """N per-replica TrialJournals behind one TrialJournal-shaped facade."""
+
+    def __init__(
+        self,
+        base_path: Path | str,
+        config: dict,
+        n_replicas: int,
+        fsync_every: int = 16,
+    ) -> None:
+        base = Path(base_path)
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.n_replicas = int(n_replicas)
+        paths = [self.replica_path(base, k) for k in range(self.n_replicas)]
+        # A previous run may have used MORE replicas: merge its extra
+        # journals too (read + compact/discard lifecycle, never written to).
+        extras = [p for p in self.discover(base) if p not in paths]
+        self.journals = [
+            TrialJournal(p, config, fsync_every=fsync_every)
+            for p in paths + extras
+        ]
+        self.config = self.journals[0].config
+        self.path = str(self.replica_path(base, "*"))
+        self._tl = threading.local()
+
+        self.resumed = any(j.resumed for j in self.journals)
+        resumed = [j for j in self.journals if j.resumed]
+        self.was_clean_stop = bool(resumed) and all(
+            j.was_clean_stop for j in resumed
+        )
+        self.gauges = RecoveryGauges()
+        for j in self.journals:
+            self.gauges.replayed_records += j.gauges.replayed_records
+            self.gauges.recovered_trials += j.gauges.recovered_trials
+            self.gauges.recovered_grades += j.gauges.recovered_grades
+            self.gauges.torn_records_dropped += j.gauges.torn_records_dropped
+            self.gauges.deferred_grades += j.gauges.deferred_grades
+        self.gauges.clean_stop = self.was_clean_stop
+
+    # -- path scheme ---------------------------------------------------------
+
+    @staticmethod
+    def replica_path(base: Path, k) -> Path:
+        base = Path(base)
+        return base.with_name(f"{base.stem}.replica{k}{base.suffix}")
+
+    @classmethod
+    def discover(cls, base: Path | str) -> list[Path]:
+        """Existing replica journal files for ``base``, sorted by replica."""
+        base = Path(base)
+        found = sorted(
+            base.parent.glob(f"{base.stem}.replica*{base.suffix}"),
+            key=lambda p: p.name,
+        )
+        return [p for p in found if not p.name.endswith(".tmp")]
+
+    # -- replica routing -----------------------------------------------------
+
+    def bind_replica(self, k: int) -> None:
+        """Route this thread's ``record_*`` calls to replica ``k``'s file."""
+        self._tl.replica = int(k)
+
+    def _writer(self) -> TrialJournal:
+        k = getattr(self._tl, "replica", 0)
+        return self.journals[k if 0 <= k < self.n_replicas else 0]
+
+    # -- TrialJournal facade: appends ---------------------------------------
+
+    def record_decoded(self, pass_key: str, idx, result: dict) -> None:
+        self._writer().record_decoded(pass_key, idx, result)
+
+    def record_graded(self, pass_key: str, idx, evaluations: dict) -> None:
+        self._writer().record_graded(pass_key, idx, evaluations)
+
+    def record_deferred(
+        self, pass_key: str, idx, error: str, attempts: int, cell=None
+    ) -> None:
+        self._writer().record_deferred(pass_key, idx, error, attempts, cell)
+        self.gauges.deferred_grades += 1
+
+    def record_cell_regraded(self, cell) -> None:
+        self._writer().record_cell_regraded(cell)
+
+    def record_clean_stop(self) -> None:
+        # Every file gets the marker: each replays independently on resume.
+        for j in self.journals:
+            j.record_clean_stop()
+
+    def flush(self) -> None:
+        for j in self.journals:
+            j.flush()
+
+    def close(self) -> None:
+        for j in self.journals:
+            j.close()
+
+    def compact(self) -> None:
+        for j in self.journals:
+            j.compact()
+
+    def discard(self) -> None:
+        for j in self.journals:
+            j.discard()
+
+    # -- TrialJournal facade: merged replayed state -------------------------
+
+    def decoded(self, pass_key: str) -> dict:
+        out: dict = {}
+        for j in self.journals:
+            out.update(j.decoded(pass_key))
+        return out
+
+    def graded(self, pass_key: str) -> dict:
+        out: dict = {}
+        for j in self.journals:
+            out.update(j.graded(pass_key))
+        return out
+
+    def deferred(self, pass_key: str) -> dict:
+        graded = self.graded(pass_key)
+        out: dict = {}
+        for j in self.journals:
+            for idx, rec in j.deferred(pass_key).items():
+                if idx not in graded:
+                    out[idx] = rec
+        return out
+
+    def deferred_cells(self) -> set:
+        cells: set = set()
+        regraded: set = set()
+        for j in self.journals:
+            cells |= j.deferred_cells()
+            # A cell regraded through ANY replica's file is resolved for the
+            # whole set (private member, same-package coupling by design).
+            regraded |= j._regraded_cells
+        return cells - regraded
+
+    def has_state(self) -> bool:
+        return any(j.has_state() for j in self.journals)

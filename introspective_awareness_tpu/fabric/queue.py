@@ -1,0 +1,173 @@
+"""Partitioned global trial queue with lease-based work stealing.
+
+The sweep fabric drains ONE global trial list through N replica workers.
+Each replica owns a contiguous partition of queue positions and claims
+work in *leases* (small index blocks); when its own partition runs dry it
+steals a lease from the tail of the most-loaded partition. Stolen trials
+keep their global queue index — the PRNG stream id — so rebalancing moves
+work between replicas without moving any trial off its sampling stream
+(the bit-identity invariant the scheduler's ``trial_ids`` provide).
+
+Lease semantics: an acquired lease is owned until ``complete`` or
+``fail``. Only un-leased tail blocks are stealable; a worker that dies
+mid-lease fails it back to its home partition, and the fabric's abort
+path plus the per-replica journals cover whatever the crashed run left
+undone. Stdlib-only and lock-protected — workers are threads.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+@dataclass
+class WorkLease:
+    """A claimed block of global queue positions."""
+
+    lease_id: int
+    replica: int            # worker holding the lease
+    home: int               # partition the indices came from
+    indices: list[int]      # global queue positions, in queue order
+    stolen: bool = False
+
+
+@dataclass
+class QueueStats:
+    """Counters for one queue lifetime (read under the queue lock)."""
+
+    leases: int = 0
+    steals: int = 0           # leases served from a foreign partition
+    stolen_trials: int = 0
+    completed_trials: int = 0
+    failed_leases: int = 0
+    peak_skew: int = 0        # max-min partition backlog seen at any acquire
+
+    def as_stats(self) -> dict:
+        return {
+            "leases": self.leases,
+            "steals": self.steals,
+            "stolen_trials": self.stolen_trials,
+            "completed_trials": self.completed_trials,
+            "failed_leases": self.failed_leases,
+            "peak_queue_skew": self.peak_skew,
+        }
+
+
+class PartitionedTrialQueue:
+    """Global positions ``0..n_items`` split into ``n_replicas`` partitions.
+
+    ``partitions`` overrides the default contiguous even split with an
+    explicit ``list[list[int]]`` (tests use a fully skewed split to force
+    steals deterministically; every position must appear exactly once).
+    """
+
+    def __init__(
+        self,
+        n_items: int,
+        n_replicas: int,
+        lease_size: int = 1,
+        partitions: Optional[Sequence[Sequence[int]]] = None,
+    ) -> None:
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.n_items = int(n_items)
+        self.n_replicas = int(n_replicas)
+        self.lease_size = max(1, int(lease_size))
+        if partitions is None:
+            bounds = [
+                round(k * self.n_items / self.n_replicas)
+                for k in range(self.n_replicas + 1)
+            ]
+            parts = [
+                list(range(bounds[k], bounds[k + 1]))
+                for k in range(self.n_replicas)
+            ]
+        else:
+            parts = [list(p) for p in partitions]
+            if len(parts) != self.n_replicas:
+                raise ValueError(
+                    f"{len(parts)} partitions for {self.n_replicas} replicas"
+                )
+            flat = sorted(i for p in parts for i in p)
+            if flat != list(range(self.n_items)):
+                raise ValueError(
+                    "partitions must cover every queue position exactly once"
+                )
+        self._parts: list[deque[int]] = [deque(p) for p in parts]
+        self._lock = threading.Lock()
+        self._next_lease = 0
+        self._outstanding: dict[int, WorkLease] = {}
+        self.stats = QueueStats()
+
+    # -- claim / release -----------------------------------------------------
+
+    def acquire(self, replica: int) -> Optional[WorkLease]:
+        """Claim the next lease for ``replica``: from its own partition's
+        head, else steal from the tail of the most-loaded partition.
+        Returns None when every partition is empty (outstanding leases may
+        still be in flight — the caller's join handles those)."""
+        with self._lock:
+            backlogs = [len(p) for p in self._parts]
+            if any(backlogs):
+                self.stats.peak_skew = max(
+                    self.stats.peak_skew, max(backlogs) - min(backlogs)
+                )
+            home = replica if 0 <= replica < self.n_replicas else 0
+            if self._parts[home]:
+                idx = [
+                    self._parts[home].popleft()
+                    for _ in range(min(self.lease_size, len(self._parts[home])))
+                ]
+                lease = WorkLease(self._next_lease, replica, home, idx)
+            else:
+                victim = max(
+                    range(self.n_replicas), key=lambda k: len(self._parts[k])
+                )
+                if not self._parts[victim]:
+                    return None
+                take = min(self.lease_size, len(self._parts[victim]))
+                # Steal from the victim's TAIL: the victim keeps consuming
+                # its head, so the two never contend for the same block.
+                idx = [self._parts[victim].pop() for _ in range(take)]
+                idx.reverse()  # back to queue order
+                lease = WorkLease(
+                    self._next_lease, replica, victim, idx, stolen=True
+                )
+                self.stats.steals += 1
+                self.stats.stolen_trials += take
+            self._next_lease += 1
+            self._outstanding[lease.lease_id] = lease
+            self.stats.leases += 1
+            return lease
+
+    def complete(self, lease: WorkLease) -> None:
+        with self._lock:
+            if self._outstanding.pop(lease.lease_id, None) is not None:
+                self.stats.completed_trials += len(lease.indices)
+
+    def fail(self, lease: WorkLease) -> None:
+        """Return a dead worker's lease to the FRONT of its home partition
+        so surviving workers (or a resume) pick it up in queue order."""
+        with self._lock:
+            if self._outstanding.pop(lease.lease_id, None) is None:
+                return
+            self._parts[lease.home].extendleft(reversed(lease.indices))
+            self.stats.failed_leases += 1
+
+    # -- introspection -------------------------------------------------------
+
+    def remaining(self) -> int:
+        """Un-leased positions still in partitions."""
+        with self._lock:
+            return sum(len(p) for p in self._parts)
+
+    def backlog(self, replica: int) -> int:
+        with self._lock:
+            return len(self._parts[replica])
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._outstanding)

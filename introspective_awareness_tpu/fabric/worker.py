@@ -1,0 +1,86 @@
+"""Replica worker: one ModelRunner draining the fabric queue on a thread.
+
+The worker owns nothing about *what* a lease means — the fabric hands it
+a ``decode(worker, lease)`` callable and the worker loops
+acquire → decode → complete until the queue is dry or the shared abort
+event fires. Any exception fails the in-flight lease back to its home
+partition, records the error, and aborts the fleet; the fabric re-raises
+the first real error after joining so crash semantics match the
+single-replica scheduler (``InjectedCrash`` propagates, graceful
+``SweepInterrupted`` flushes journals upstream).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from introspective_awareness_tpu.runtime.journal import SweepInterrupted
+
+from .queue import PartitionedTrialQueue, WorkLease
+
+
+@dataclass
+class ReplicaStats:
+    replica: int
+    trials: int = 0
+    leases: int = 0
+    stolen_leases: int = 0
+    busy_s: float = 0.0
+
+    def as_stats(self) -> dict:
+        return {
+            "trials": self.trials,
+            "leases": self.leases,
+            "stolen_leases": self.stolen_leases,
+            "busy_s": round(self.busy_s, 4),
+        }
+
+
+class ReplicaWorker:
+    """Wraps one ModelRunner as fabric replica ``replica_id``.
+
+    Sets ``runner.replica_label`` so the slot scheduler's metrics land in
+    this replica's label series instead of the shared default.
+    """
+
+    def __init__(self, replica_id: int, runner) -> None:
+        self.replica_id = int(replica_id)
+        self.runner = runner
+        runner.replica_label = str(self.replica_id)
+        self.stats = ReplicaStats(self.replica_id)
+        self.error: Optional[BaseException] = None
+        self.interrupted = False
+
+    def drain(
+        self,
+        queue: PartitionedTrialQueue,
+        decode: Callable[["ReplicaWorker", WorkLease], None],
+        abort: threading.Event,
+    ) -> None:
+        try:
+            while not abort.is_set():
+                lease = queue.acquire(self.replica_id)
+                if lease is None:
+                    return
+                t0 = time.perf_counter()
+                try:
+                    decode(self, lease)
+                except BaseException:
+                    queue.fail(lease)
+                    raise
+                finally:
+                    self.stats.busy_s += time.perf_counter() - t0
+                queue.complete(lease)
+                self.stats.leases += 1
+                self.stats.stolen_leases += int(lease.stolen)
+                self.stats.trials += len(lease.indices)
+        except SweepInterrupted as e:
+            self.interrupted = True
+            self.error = e
+            abort.set()
+        except BaseException as e:  # noqa: BLE001 — reported by the fabric
+            self.error = e
+            abort.set()

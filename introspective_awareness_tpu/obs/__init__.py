@@ -63,7 +63,11 @@ from introspective_awareness_tpu.obs.timing import (
     profile_trace,
     timed,
 )
-from introspective_awareness_tpu.obs.http import MetricsServer, ProgressTracker
+from introspective_awareness_tpu.obs.http import (
+    AggregateProgress,
+    MetricsServer,
+    ProgressTracker,
+)
 from introspective_awareness_tpu.obs.registry import (
     MetricsRegistry,
     default_registry,
@@ -71,6 +75,7 @@ from introspective_awareness_tpu.obs.registry import (
 from introspective_awareness_tpu.obs.trace import ChunkTrace, format_attribution
 
 __all__ = [
+    "AggregateProgress",
     "AutotuneResult",
     "ChunkTrace",
     "CompileAccounting",

@@ -100,6 +100,60 @@ class ProgressTracker:
         return out
 
 
+class AggregateProgress(ProgressTracker):
+    """Fabric-aware progress: the coordinator's own tracker plus one
+    per-replica child tracker (``replica(name)`` get-or-creates). The
+    snapshot SUMS trials done/total and evals/s across all of them, takes
+    the minimum of the available ETAs, and attaches a ``replicas``
+    sub-document — so ``/progress`` reports the whole fleet, not just the
+    serving process's tracker. Degenerates to a plain ProgressTracker
+    while no replica has registered."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._rep_lock = threading.Lock()
+        self._replicas: dict[str, ProgressTracker] = {}
+
+    def replica(self, name: str) -> ProgressTracker:
+        with self._rep_lock:
+            t = self._replicas.get(str(name))
+            if t is None:
+                t = self._replicas[str(name)] = ProgressTracker()
+            return t
+
+    def snapshot(self) -> dict[str, Any]:
+        doc = super().snapshot()
+        with self._rep_lock:
+            replicas = dict(self._replicas)
+        if not replicas:
+            return doc
+        snaps = {k: t.snapshot() for k, t in sorted(replicas.items())}
+        done = doc["trials_done"] + sum(
+            s["trials_done"] for s in snaps.values()
+        )
+        total = doc["trials_total"] + sum(
+            s["trials_total"] for s in snaps.values()
+        )
+        rate = doc["evals_per_s"] + sum(
+            s["evals_per_s"] for s in snaps.values()
+        )
+        etas = [s["eta_s"] for s in snaps.values() if s["eta_s"] is not None]
+        if rate > 0 and total > done:
+            etas.append((total - done) / rate)
+        doc.update(
+            trials_done=done,
+            trials_total=total,
+            evals_per_s=round(rate, 4),
+            eta_s=round(min(etas), 1) if etas else None,
+        )
+        doc["replicas"] = {
+            k: {f: s[f] for f in
+                ("trials_done", "evals_per_s", "phase", "elapsed_s")}
+            for k, s in snaps.items()
+        }
+        return doc
+
+
 def _progress_doc(registry: MetricsRegistry,
                   progress: Optional[ProgressTracker]) -> dict[str, Any]:
     doc = progress.snapshot() if progress is not None else {}
@@ -109,15 +163,26 @@ def _progress_doc(registry: MetricsRegistry,
         if m["type"] == "histogram":
             continue
         series = m["series"]
-        if len(series) == 1 and not series[0]["labels"]:
-            (gauges if m["type"] == "gauge" else counters)[name] = (
-                series[0]["value"]
-            )
+        if m["type"] == "counter":
+            # Counters are summable: the plain name always carries the
+            # across-series aggregate (a per-replica-labeled counter still
+            # reads as one fleet total), labeled entries ride along when
+            # the label set is non-trivial.
+            counters[name] = sum(row["value"] for row in series)
+            if len(series) > 1 or (series and series[0]["labels"]):
+                for row in series:
+                    lab = ",".join(
+                        f"{k}={v}" for k, v in row["labels"].items()
+                    )
+                    counters[f"{name}{{{lab}}}"] = row["value"]
+        elif len(series) == 1:
+            # A single gauge series reads under its plain name even when
+            # labeled (the common solo-replica case).
+            gauges[name] = series[0]["value"]
         else:
-            dst = gauges if m["type"] == "gauge" else counters
             for row in series:
                 lab = ",".join(f"{k}={v}" for k, v in row["labels"].items())
-                dst[f"{name}{{{lab}}}"] = row["value"]
+                gauges[f"{name}{{{lab}}}"] = row["value"]
     doc["gauges"] = gauges
     doc["counters"] = counters
     return doc
@@ -201,6 +266,7 @@ class MetricsServer:
 
 
 __all__ = [
+    "AggregateProgress",
     "MetricsServer",
     "ProgressTracker",
     "PROM_CONTENT_TYPE",

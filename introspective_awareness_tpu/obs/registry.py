@@ -10,6 +10,14 @@ and snapshotted into ``run_manifest.json`` at sweep exit.
 Label sets are bounded: each metric holds at most ``max_series`` label
 combinations; further ones collapse into a single ``other`` series so a
 bug (or a per-trial label) can never grow the registry without bound.
+``reserve_label_values`` carves a registry-wide budget OUT of that cap
+for known-legitimate label values (the sweep fabric reserves its replica
+ids): reserved series are admitted even when unreserved churn has filled
+``max_series``, and unreserved churn never counts reserved series
+against its own budget — so N-replica series can neither be collapsed
+into ``other`` by a high-cardinality bug nor starve ordinary series.
+Reservations are themselves bounded (``RESERVED_VALUES_MAX`` values per
+label, ``RESERVED_SERIES_MAX`` admitted series per metric).
 
 Metric updates are a dict lookup + float add under one registry lock —
 micro-seconds, safe to call per processed chunk. The hot loop fetches
@@ -24,6 +32,12 @@ import time
 from typing import Any, Optional, Sequence
 
 _OVERFLOW = "other"
+
+# Per-label cap on reserved values and per-metric cap on reserved series:
+# reservations bypass max_series, so they need their own hard ceilings
+# (a v5e-64 fabric is 8 replicas of 8 chips; 64 leaves pod headroom).
+RESERVED_VALUES_MAX = 64
+RESERVED_SERIES_MAX = 128
 
 DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
@@ -40,19 +54,49 @@ class _Metric:
     kind = "untyped"
 
     def __init__(self, name: str, help: str, labelnames: Sequence[str],
-                 lock: threading.RLock, max_series: int) -> None:
+                 lock: threading.RLock, max_series: int,
+                 reserved: Optional[dict[str, set]] = None) -> None:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
         self._lock = lock
         self.max_series = max(1, int(max_series))
         self._series: dict[tuple, Any] = {}
+        # Shared with the owning registry: labelname -> reserved values.
+        # Mutations through reserve_label_values are visible to every
+        # metric, including ones created before the reservation.
+        self._reserved = reserved if reserved is not None else {}
+
+    def _is_reserved(self, key: tuple) -> bool:
+        """A series is reserved iff every labelname that HAS reservations
+        takes a reserved value, and at least one such labelname exists —
+        so one reserved label can't smuggle unbounded values of another."""
+        hit = False
+        for n, v in zip(self.labelnames, key):
+            vals = self._reserved.get(n)
+            if vals is not None:
+                if v in vals:
+                    hit = True
+                else:
+                    return False
+        return hit
 
     def _key(self, labels: dict[str, Any]) -> tuple:
         if not self.labelnames:
             return ()
         key = tuple(str(labels.get(n, "")) for n in self.labelnames)
-        if key not in self._series and len(self._series) >= self.max_series:
+        if key in self._series:
+            return key
+        if self._is_reserved(key):
+            # Reserved series bypass the unreserved budget but have their
+            # own hard cap; past it they fall through to normal budgeting.
+            n_reserved = sum(1 for k in self._series if self._is_reserved(k))
+            if n_reserved < RESERVED_SERIES_MAX:
+                return key
+        n_unreserved = sum(
+            1 for k in self._series if not self._is_reserved(k)
+        )
+        if n_unreserved >= self.max_series:
             return (_OVERFLOW,) * len(self.labelnames)
         return key
 
@@ -112,8 +156,9 @@ class Histogram(_Metric):
 
     def __init__(self, name: str, help: str, labelnames: Sequence[str],
                  lock: threading.RLock, max_series: int,
+                 reserved: Optional[dict[str, set]] = None,
                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
-        super().__init__(name, help, labelnames, lock, max_series)
+        super().__init__(name, help, labelnames, lock, max_series, reserved)
         self.buckets = tuple(sorted(float(b) for b in buckets))
 
     def _zero(self) -> list:
@@ -139,6 +184,28 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._metrics: dict[str, _Metric] = {}
+        # labelname -> reserved values; ONE dict shared (by reference) with
+        # every metric, so reservations apply retroactively.
+        self._reserved: dict[str, set] = {}
+
+    def reserve_label_values(self, labelname: str,
+                             values: Sequence[str]) -> None:
+        """Guarantee series slots for known-legitimate ``labelname`` values
+        (the sweep fabric reserves its replica ids): series whose reserved
+        labels all take reserved values are admitted outside every metric's
+        ``max_series`` budget, and unreserved churn can no longer evict or
+        block them. Idempotent; values accumulate across calls up to
+        ``RESERVED_VALUES_MAX`` per label."""
+        vals = {str(v) for v in values}
+        with self._lock:
+            have = self._reserved.setdefault(str(labelname), set())
+            if len(have | vals) > RESERVED_VALUES_MAX:
+                raise ValueError(
+                    f"label {labelname!r} reservation would exceed "
+                    f"{RESERVED_VALUES_MAX} values — a reserved label must "
+                    f"stay low-cardinality"
+                )
+            have |= vals
 
     def _get_or_create(self, cls, name: str, help: str,
                        labelnames: Sequence[str], max_series: int,
@@ -152,7 +219,8 @@ class MetricsRegistry:
                         f"with labels {m.labelnames}"
                     )
                 return m
-            m = cls(name, help, labelnames, self._lock, max_series, **kw)
+            m = cls(name, help, labelnames, self._lock, max_series,
+                    reserved=self._reserved, **kw)
             self._metrics[name] = m
             return m
 
@@ -184,9 +252,11 @@ class MetricsRegistry:
         return None if v is None else float(v)
 
     def clear(self) -> None:
-        """Drop every metric (tests only — live handles go stale)."""
+        """Drop every metric and reservation (tests only — live handles go
+        stale)."""
         with self._lock:
             self._metrics.clear()
+            self._reserved.clear()
 
     # -- exposition ---------------------------------------------------------
 

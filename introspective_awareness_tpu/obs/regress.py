@@ -55,11 +55,23 @@ def _overlap(doc: dict) -> Optional[float]:
     return (doc.get("staged_prefill") or {}).get("prefill_overlap_frac")
 
 
+def _fabric_evals(doc: dict) -> Optional[float]:
+    fab = doc.get("fabric") or {}
+    if fab.get("skipped"):
+        return None
+    return fab.get("aggregate_evals_per_s")
+
+
 HEADLINES: tuple = (
     ("evals_per_sec_chip", _value, True, 0.10, 0.0),
     ("decode_steps_per_sec", _decode_steps, True, 0.15, 0.0),
     ("bubble_frac", _bubble, False, 0.0, 0.10),
     ("prefill_overlap_frac", _overlap, True, 0.0, 0.10),
+    # Fabric replica scaling: 2-replica aggregate throughput from the bench's
+    # "fabric" section. Wide tolerance — replicas time-share devices on the
+    # CPU smoke, so thread scheduling adds noise throughput metrics above
+    # don't see. Skipped (not failed) against history predating the section.
+    ("fabric_aggregate_evals_per_s", _fabric_evals, True, 0.25, 0.0),
 )
 
 
@@ -191,6 +203,9 @@ def inject_regression(history: list[tuple[Optional[dict], Any]],
     if isinstance(cur.get("staged_prefill"), dict) and \
             cur["staged_prefill"].get("prefill_overlap_frac") is not None:
         cur["staged_prefill"]["prefill_overlap_frac"] *= factor
+    if isinstance(cur.get("fabric"), dict) and \
+            cur["fabric"].get("aggregate_evals_per_s"):
+        cur["fabric"]["aggregate_evals_per_s"] *= factor
     return cur
 
 

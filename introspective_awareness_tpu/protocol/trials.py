@@ -42,6 +42,7 @@ def run_trial_pass(
     stop_event=None,
     faults=None,
     trace=None,
+    fabric=None,
 ) -> list[dict]:
     """One batched pass of a trial type over (concept, trial) tasks.
 
@@ -74,6 +75,7 @@ def run_trial_pass(
             staged=staged, grade_pool=grade_pool,
             journal=journal, pass_key=pass_key,
             stop_event=stop_event, faults=faults, trace=trace,
+            fabric=fabric,
         )
     if scheduler != "batch":
         raise ValueError(f"unknown scheduler {scheduler!r}")
@@ -145,6 +147,7 @@ def run_grid_pass(
     stop_event=None,
     faults=None,
     trace=None,
+    fabric=None,
 ) -> list[dict]:
     """One batched pass where every row may belong to a DIFFERENT
     (layer, strength) cell — the fused-sweep path.
@@ -189,6 +192,13 @@ def run_grid_pass(
     :class:`~introspective_awareness_tpu.obs.ChunkTrace`; continuous only)
     records per-chunk dispatch/land/harvest events for the flight-recorder
     timeline and attribution.
+
+    ``fabric`` (a :class:`~introspective_awareness_tpu.fabric.SweepFabric`;
+    continuous only) drains the pass through N replica runners instead of
+    ``runner`` — the fabric exposes the same ``generate_grid_scheduled``
+    surface, and queue indices are always passed as ``trial_ids`` so every
+    replica decodes its leases on the global PRNG streams (bit-identical to
+    the single-replica run, with or without work stealing).
     """
     if trial_type not in TRIAL_TYPES:
         raise ValueError(f"unknown trial_type {trial_type!r} (expected {TRIAL_TYPES})")
@@ -198,6 +208,11 @@ def run_grid_pass(
         raise ValueError(
             "trial journal requires scheduler='continuous' (the batch path "
             "has no per-trial completion events to journal)"
+        )
+    if fabric is not None and scheduler != "continuous":
+        raise ValueError(
+            "the sweep fabric requires scheduler='continuous' (leases drain "
+            "through the slot scheduler)"
         )
     injected = trial_type != "control"
 
@@ -300,9 +315,10 @@ def run_grid_pass(
                     )
 
         responses: list[str] = []
+        engine = fabric if fabric is not None else runner
         if remaining:
             try:
-                responses = runner.generate_grid_scheduled(
+                responses = engine.generate_grid_scheduled(
                     [prompts[i] for i in remaining],
                     layer_indices=[layers[i] for i in remaining],
                     steering_vectors=[vecs[i] for i in remaining],
@@ -314,7 +330,14 @@ def run_grid_pass(
                     slots=batch_size,
                     staged=staged,
                     result_cb=result_cb,
-                    trial_ids=remaining if journal is not None else None,
+                    # The fabric always needs the global stream ids (its
+                    # leases are subsets); solo runs only need them when a
+                    # journal may replay a subset later.
+                    trial_ids=(
+                        remaining
+                        if (journal is not None or fabric is not None)
+                        else None
+                    ),
                     stop_event=stop_event,
                     faults=faults,
                     trace=trace,

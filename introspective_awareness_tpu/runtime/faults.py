@@ -15,7 +15,13 @@ strike —
   streaming-grade batches fail with the named error class (judge outage /
   shared rate-limit event / server errors) before any real client call;
 - ``torn_tail``: after a crash, shear the journal's final record mid-line
-  (:meth:`tear_tail`) the way a kill mid-``write`` does.
+  (:meth:`tear_tail`) the way a kill mid-``write`` does;
+- ``kill_replica=k``: scope the whole plan to fabric replica k — the
+  sweep fabric hands the plan only to that replica's worker, so e.g.
+  ``crash_after_chunks=2,kill_replica=1`` kills exactly one worker
+  mid-sweep while the others run clean (the kill-one-worker resume
+  drill). Without it a plan afflicts every replica through shared
+  counters.
 
 Plans parse from a spec string (``--inject-faults`` /  the ``IAT_FAULTS``
 env var): comma-separated ``key=value`` pairs, bare keys meaning 1 —
@@ -69,6 +75,9 @@ class FaultPlan:
     judge_rate_limit: int = 0
     judge_5xx: int = 0
     torn_tail: int = 0
+    # Fabric targeting: None = every replica; an int scopes the plan to
+    # that replica id (SweepFabric passes other replicas faults=None).
+    kill_replica: Optional[int] = None
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
     )
@@ -79,6 +88,7 @@ class FaultPlan:
     _KEYS = (
         "crash_after_chunks", "crash_on_admission",
         "judge_timeout", "judge_rate_limit", "judge_5xx", "torn_tail",
+        "kill_replica",
     )
 
     @classmethod

@@ -905,6 +905,7 @@ class ModelRunner:
                 suffix_bucket=suffix_bucket, result_cb=tok_cb,
                 trial_ids=trial_ids, stop_event=stop_event, faults=faults,
                 trace=trace,
+                replica=str(getattr(self, "replica_label", "0")),
             )
             done = [r for r in results if r is not None]
             span.add_evals(len(done))

@@ -181,6 +181,7 @@ def run_scheduled(
     stop_event=None,
     faults=None,
     trace=None,
+    replica: str = "0",
 ) -> tuple[list[np.ndarray], dict]:
     """Drain ``trials`` through ``slots`` decode rows; returns per-trial
     token arrays (input order, length = tokens actually emitted, final
@@ -230,6 +231,10 @@ def run_scheduled(
     host-wait/device-busy/dispatch-gap attribution and Perfetto export.
     Recording is one tuple append per event (bench A/B-gates the loop
     overhead at <= 2%); the default ``None`` skips it entirely.
+
+    ``replica`` labels this run's live-metrics series in the registry so
+    concurrent sweep-fabric replicas stay distinguishable; single-replica
+    runs land in the default ``replica="0"`` series.
     """
     ledger = ledger if ledger is not None else NullLedger()
     B = slots
@@ -356,21 +361,28 @@ def run_scheduled(
     # lookup); per-chunk updates are a float add under the registry lock,
     # present in BOTH legs of the bench trace-overhead A/B.
     _reg = default_registry()
+    _rl = {"replica": str(replica)}  # fabric replica series; "0" solo
     m_chunks = _reg.counter(
-        "iat_scheduler_chunks_total", "decode chunks processed")
+        "iat_scheduler_chunks_total", "decode chunks processed",
+        labelnames=("replica",))
     m_refills = _reg.counter(
-        "iat_scheduler_refills_total", "refill/admit dispatches")
+        "iat_scheduler_refills_total", "refill/admit dispatches",
+        labelnames=("replica",))
     m_wait = _reg.counter(
         "iat_scheduler_host_wait_seconds_total",
-        "blocking flag-wait seconds in the host loop")
+        "blocking flag-wait seconds in the host loop",
+        labelnames=("replica",))
     m_occ = _reg.gauge(
         "iat_scheduler_slot_occupancy",
-        "live-slot fraction at the last processed chunk")
+        "live-slot fraction at the last processed chunk",
+        labelnames=("replica",))
     m_depth = _reg.gauge(
         "iat_scheduler_inflight_depth",
-        "dispatches still in flight after the last harvest")
+        "dispatches still in flight after the last harvest",
+        labelnames=("replica",))
     m_final = _reg.counter(
-        "iat_scheduler_trials_finalized_total", "trials finalized")
+        "iat_scheduler_trials_finalized_total", "trials finalized",
+        labelnames=("replica",))
 
     def _dispatch_refill() -> None:
         nonlocal cache, state, next_trial, refills, d_seq
@@ -408,7 +420,7 @@ def run_scheduled(
         if trace is not None:
             trace.dispatch("refill", d_seq)
         d_seq += 1
-        m_refills.inc()
+        m_refills.inc(**_rl)
         gauges.dispatched(len(pending))
         next_trial += take
         refills += 1
@@ -516,7 +528,7 @@ def run_scheduled(
             if trace is not None:
                 trace.dispatch("refill", d_seq)
             d_seq += 1
-            m_refills.inc()
+            m_refills.inc(**_rl)
             gauges.dispatched(len(pending))
             sgauges.admitted()
             grp.cursor += take
@@ -551,7 +563,7 @@ def run_scheduled(
         toks = np.asarray(ev.toks)
         wait_s = time.perf_counter() - t0
         gauges.waited(wait_s)
-        m_wait.inc(wait_s)
+        m_wait.inc(wait_s, **_rl)
         if trace is not None:
             trace.landed(ev.kind, ev.seq, t0, t0 + wait_s)
         done = flags[:B] != 0
@@ -563,8 +575,8 @@ def run_scheduled(
             occupancy_sum += live / B
             waste_steps += (B - live) * ch
             chunks_done += 1
-            m_chunks.inc()
-            m_occ.set(live / B)
+            m_chunks.inc(**_rl)
+            m_occ.set(live / B, **_rl)
             for s in range(B):
                 ti = int(ev.owners[s])
                 if ti >= 0 and results[ti] is None:
@@ -596,11 +608,11 @@ def run_scheduled(
                 if slot_trial[s] == ti:
                     slot_trial[s] = -1
                     rem[s] = 0
-                m_final.inc()
+                m_final.inc(**_rl)
                 if result_cb is not None:
                     result_cb(ti, results[ti])
         last_done = done
-        m_depth.set(len(pending))
+        m_depth.set(len(pending), **_rl)
         if trace is not None:
             trace.processed(ev.kind, ev.seq)
         if not pending:

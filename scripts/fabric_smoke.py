@@ -1,0 +1,131 @@
+"""Sweep-fabric smoke: 2-replica identity + kill-one-worker merged resume.
+
+The CI lane for the fabric contract (README "Sweep fabric"), runnable
+anywhere the tier-1 suite runs — replicas are CPU-emulated devices:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/fabric_smoke.py [--temperature 1.0]
+
+Phase 1 — identity: a single-replica reference sweep vs the same sweep on
+``--fabric-replicas 2``; every cell's results.json must match exactly.
+Default temperature is 1.0: sampled decoding is the strong form of the
+claim (trial PRNG streams keyed by global queue index, not by replica).
+
+Phase 2 — kill one worker: the 2-replica sweep is crashed by an injected
+fault targeting replica 1 only (``crash_after_chunks=2,kill_replica=1``);
+both per-replica journals must survive, and the resumed run must replay
+their merged state into cells byte-identical to the reference, recovering
+>0 trials, then discard every journal file.
+
+Exit code 0 = both phases hold. Any assertion prints what diverged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _argv(out_dir: Path, temperature: float, extra=()) -> list[str]:
+    return [
+        "--models", "tiny",
+        "--concepts", "Dust", "Trees",
+        "--n-baseline", "5",
+        "--layer-sweep", "0.25", "0.75",
+        "--strength-sweep", "2.0", "8.0",
+        "--n-trials", "4",
+        "--max-tokens", "8",
+        "--batch-size", "16",
+        "--temperature", str(temperature),
+        "--output-dir", str(out_dir),
+        "--dtype", "float32",
+        "--judge-backend", "none",
+        "--scheduler", "continuous",
+        "--obs-ledger", "off",
+        *extra,
+    ]
+
+
+def _cells(out_dir: Path) -> dict:
+    return {
+        p.parent.name: json.loads(p.read_text())
+        for p in sorted((out_dir / "tiny").glob("layer_*/results.json"))
+    }
+
+
+def phase_identity(base: Path, temperature: float) -> dict:
+    from introspective_awareness_tpu.cli.sweep import main
+
+    print(f"[phase 1] 2-replica identity (temperature {temperature})")
+    assert main(_argv(base / "ref", temperature)) == 0
+    ref = _cells(base / "ref")
+    assert ref, "reference sweep produced no cells"
+
+    assert main(_argv(base / "fab", temperature,
+                      ["--fabric-replicas", "2"])) == 0
+    fab = _cells(base / "fab")
+    diverged = [c for c in ref if fab.get(c) != ref[c]]
+    assert not diverged, f"cells diverged under 2 replicas: {diverged}"
+    print(f"[phase 1] OK: {len(ref)} cells identical across replica counts")
+    return ref
+
+
+def phase_kill_worker(base: Path, temperature: float, ref: dict) -> dict:
+    from introspective_awareness_tpu.cli.sweep import main
+    from introspective_awareness_tpu.fabric import FabricJournalSet
+    from introspective_awareness_tpu.runtime.faults import InjectedCrash
+
+    print("[phase 2] kill replica 1 mid-sweep -> merged-journal resume")
+    argv = _argv(base / "kill", temperature, ["--fabric-replicas", "2"])
+    try:
+        main(argv + ["--inject-faults", "crash_after_chunks=2,kill_replica=1"])
+        raise AssertionError("injected crash never fired")
+    except InjectedCrash:
+        pass
+    jbase = base / "kill" / "tiny" / "trial_journal.jsonl"
+    left = FabricJournalSet.discover(jbase)
+    assert len(left) >= 2, f"expected per-replica journals, found {left}"
+
+    assert main(argv) == 0, "resume run failed"
+    resumed = _cells(base / "kill")
+    diverged = [c for c in ref if resumed.get(c) != ref[c]]
+    assert not diverged, f"cells diverged after kill+resume: {diverged}"
+    assert not FabricJournalSet.discover(jbase), "journals not discarded"
+    assert not jbase.exists(), "stray base journal left behind"
+
+    man = json.loads(
+        (base / "kill" / "tiny" / "run_manifest.json").read_text()
+    )
+    rec = man["timings"]["recovery"]
+    assert rec["recovered_trials"] > 0, f"nothing recovered: {rec}"
+    print(f"[phase 2] OK: {len(ref)} cells identical, "
+          f"{rec['recovered_trials']} trials recovered from merged journals")
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--temperature", type=float, default=1.0)
+    ns = ap.parse_args()
+
+    with tempfile.TemporaryDirectory(prefix="fabric_smoke_") as td:
+        base = Path(td)
+        ref = phase_identity(base, ns.temperature)
+        rec = phase_kill_worker(base, ns.temperature, ref)
+
+    print(json.dumps({
+        "fabric_smoke": "ok",
+        "temperature": ns.temperature,
+        "cells": len(ref),
+        "recovered_trials": rec["recovered_trials"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,11 @@ degraded doc from the newest history round itself, so CI can assert the
 regress path fires on any backend. Stdlib-only: ``regress.py`` is
 loaded by file path, so no jax install is needed.
 
+Gated metrics include the sweep fabric's 2-replica aggregate throughput
+(``fabric.aggregate_evals_per_s``); rounds predating the bench "fabric"
+section are skipped for that metric, never failed, so the gate picks up
+the replica-scaling trajectory as soon as one BENCH round carries it.
+
 Examples:
     python scripts/perf_gate.py --current bench_out.json
     python scripts/perf_gate.py --inject-regression   # must exit 1

@@ -48,3 +48,11 @@ def mesh1():
     import jax
 
     return build_mesh(MeshConfig(dp=1, tp=1), devices=jax.devices()[:1])
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 lane (ROADMAP `-m 'not slow'`); "
+        "run by dedicated CI jobs (e.g. fabric-smoke) or explicitly",
+    )
