@@ -364,6 +364,133 @@ def _sched_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     return r
 
 
+def _speculative_compare(runner, cfg, tok, slots, ledger, on_tpu) -> dict:
+    """Self-speculative decode vs the plain continuous scheduler, same queue.
+
+    Both legs drain an identical steered trial queue through
+    ``generate_grid_scheduled``; the speculative leg adds ``--speculate-k``
+    style early-exit drafting (k tokens proposed by the model's first D
+    layers + the shared LM head, one full-depth verify per round). Greedy
+    outputs must be BIT-IDENTICAL — the timed A/B doubles as the identity
+    probe, so the speedup is only ever reported next to that check.
+
+    The workload is chosen to demonstrate the mechanism where it actually
+    pays, not to flatter it:
+
+    * Steering at layer 1 with the injection dominating the residual stream
+      (the paper's high-strength regime) — the drafter runs the SAME steered
+      layers, so its proposals track the full model and acceptance goes to
+      ~1.0. Steering above the draft cut would hide the injection from the
+      drafter and acceptance collapses (that regime is covered by tests, not
+      benched).
+    * Decode-dominated budgets (256 tokens): speculation amortizes the
+      host<->device chunk cadence and the merge, which a 32-token smoke
+      budget would drown in prefill and tail effects.
+    * On the CPU smoke the section builds its own 16-layer tiny model:
+      drafting wins by skipping (full - D) layers per proposed token, and at
+      4 layers the D=2 drafter can only ever skip half the stack — the
+      measured ceiling is ~1.4x before bookkeeping. 16 layers is the
+      smallest depth where the CPU op-count ratio comfortably clears 1.5x.
+      On TPU the bench's own 1B-shape params are reused (16 layers already,
+      and decode there is weight-bandwidth-bound: a D=3 draft reads 3/16 of
+      the per-layer weights).
+    """
+    import time as _time
+
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    spec_k, draft_layers, budget = 3, 3, 256
+    if on_tpu:
+        params, sec_cfg = runner.params, cfg
+    else:
+        import dataclasses as _dc
+
+        import jax as _jax
+
+        from introspective_awareness_tpu.models.transformer import init_params
+
+        sec_cfg = _dc.replace(cfg, n_layers=16)
+        init = _jax.jit(init_params, static_argnames=("cfg",))
+        params = init(sec_cfg, _jax.random.key(7))
+    sec_runner = ModelRunner(
+        params, sec_cfg, tok, model_name="bench-spec",
+        seq_multiple=16, batch_multiple=slots, ledger=ledger,
+    )
+
+    N = 2 * slots
+    preamble = (
+        "I am an interpretability researcher studying transformer-based "
+        "language models. I can inject thoughts into your mind. "
+    )
+    prompts = [
+        preamble + f"Trial {i}: do you detect an injected thought?"
+        for i in range(N)
+    ]
+    rng = np.random.default_rng(0)
+    vecs = [
+        rng.normal(size=sec_cfg.hidden_size).astype(np.float32) * 4.0
+        for _ in range(N)
+    ]
+    # Steering starts past the shared-prefix split so speculation stays
+    # eligible; strength 128 puts the injection in the residual-dominating
+    # regime where the early-exit drafter tracks the full model.
+    starts = [len(preamble) + 2] * N
+
+    def run(k, dl):
+        return sec_runner.generate_grid_scheduled(
+            prompts, layer_indices=[1] * N, steering_vectors=vecs,
+            strengths=[128.0] * N, max_new_tokens=budget, temperature=0.0,
+            steering_start_positions=starts, seed=0, slots=slots,
+            speculate_k=k, draft_layers=dl,
+        )
+
+    run(0, None)  # compile both legs before timing
+    run(spec_k, draft_layers)
+    t0 = _time.perf_counter()
+    base_out = run(0, None)
+    t_base = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    spec_out = run(spec_k, draft_layers)
+    t_spec = _time.perf_counter() - t0
+    identical = spec_out == base_out
+
+    spans = [
+        e for e in ledger.events
+        if e.get("ev") == "span" and e.get("phase") == "generate_scheduled"
+    ]
+    gauges = spans[-1] if spans else {}
+    # Decode-step-equivalent rate: tokens a slot row advances per second.
+    # Both legs emit the same tokens (identical outputs), so the speedup is
+    # exact; the speculative leg packs up to k+1 of them per verify.
+    steps = N * (budget - 1) / slots
+    r = {
+        "speculate_k": spec_k,
+        "draft_layers": draft_layers,
+        "n_layers": sec_cfg.n_layers,
+        "queue_trials": N,
+        "slots": slots,
+        "budget": budget,
+        "baseline_time_s": round(t_base, 3),
+        "speculative_time_s": round(t_spec, 3),
+        "speedup": round(t_base / t_spec, 3) if t_spec > 0 else None,
+        "decode_steps_per_s": round(steps / t_base, 3) if t_base > 0 else None,
+        "speculative_decode_steps_per_s": (
+            round(steps / t_spec, 3) if t_spec > 0 else None
+        ),
+        "outputs_identical": identical,
+        "spec_acceptance_rate": gauges.get("spec_acceptance_rate"),
+        "spec_tokens_per_round": gauges.get("spec_tokens_per_round"),
+        "decode_chunks": gauges.get("chunks"),
+    }
+    log(
+        f"  [speculative] {N} trials x {slots} slots, budget {budget}, "
+        f"k={spec_k} D={draft_layers}/{sec_cfg.n_layers}: base {t_base:.2f}s "
+        f"vs spec {t_spec:.2f}s -> {r['speedup']}x, identical={identical}, "
+        f"acceptance={r['spec_acceptance_rate']}"
+    )
+    return r
+
+
 def _pipeline_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     """Pipelined vs synchronous scheduler host loop on the same queue shape
     as ``_sched_compare`` (mixed budgets, 5 short : 1 long).
@@ -1172,6 +1299,14 @@ def main() -> None:
         ledger,
     )
 
+    # ---- self-speculative decode vs plain scheduler, bit-identical ---------
+    spec = _gated(
+        "speculative",
+        lambda: _speculative_compare(runner, cfg, tok, batches[0], ledger,
+                                     on_tpu),
+        ledger,
+    )
+
     # ---- pipelined vs synchronous host loop + grading overlap --------------
     pipe = _gated(
         "pipeline",
@@ -1469,6 +1604,7 @@ def main() -> None:
         ],
         "token_stats": stats,
         "scheduler": sched,
+        "speculative": spec,
         "pipeline": pipe,
         "staged_prefill": stg,
         "durability": dur,

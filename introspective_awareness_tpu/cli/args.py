@@ -83,6 +83,24 @@ def build_parser() -> argparse.ArgumentParser:
                              "bit-identical to unstaged; see the README "
                              "staged-admission section for lookahead / "
                              "suffix-bucket tuning.")
+    parser.add_argument("--speculate-k", type=int, default=0,
+                        help="With --scheduler continuous: self-speculative "
+                             "decode — an early-exit drafter (the model's "
+                             "first --draft-layers layers + the shared LM "
+                             "head) proposes k tokens per slot per round and "
+                             "ONE full-depth forward verifies all of them, "
+                             "accepting the longest matching prefix. Greedy "
+                             "outputs are bit-identical to --speculate-k 0; "
+                             "temperature>0 draws are distribution-identical "
+                             "(rejection sampling on the same per-trial PRNG "
+                             "streams, so resumed sweeps must keep the same "
+                             "speculation config). 0 disables.")
+    parser.add_argument("--draft-layers", type=int, default=None,
+                        help="Early-exit depth of the self-speculative "
+                             "drafter (layers [0, D) of the SAME weights; "
+                             "steering at layers < D applies identically in "
+                             "draft and verify). Default: n_layers // 2. "
+                             "Only meaningful with --speculate-k > 0.")
     parser.add_argument("-od", "--output-dir", type=str, default=DEFAULT_OUTPUT_DIR)
     parser.add_argument("-dt", "--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float16", "float32"])

@@ -525,6 +525,8 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 max_new_tokens=args.max_tokens, temperature=args.temperature,
                 batch_size=args.batch_size, seed=args.seed + k * 1_000_003,
                 scheduler=args.scheduler, staged=args.staged_prefill,
+                speculate_k=args.speculate_k,
+                draft_layers=args.draft_layers,
                 grade_pool=_make_pool(pass_key),
                 journal=journal, pass_key=pass_key,
                 stop_event=stop_event, faults=faults, trace=trace,
@@ -579,6 +581,8 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 layer_fraction=lf, batch_size=args.batch_size,
                 seed=args.seed + ci * len(strengths) + si,
                 scheduler=args.scheduler, staged=args.staged_prefill,
+                speculate_k=args.speculate_k,
+                draft_layers=args.draft_layers,
             )
             results = []
             for trial_type, trial_nums in trial_plan:
@@ -613,6 +617,11 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
 
     timings["scheduler"] = args.scheduler
     timings["staged_prefill"] = bool(args.staged_prefill)
+    timings["speculate_k"] = int(args.speculate_k)
+    timings["draft_layers"] = (
+        int(args.draft_layers) if args.speculate_k and args.draft_layers
+        else None
+    )
     timings["generation_s"] = round(t_gen, 3)
     if n_generated and t_gen > 0:
         # The BASELINE.json north-star counter, recorded per real run — not

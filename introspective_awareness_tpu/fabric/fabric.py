@@ -131,6 +131,8 @@ class SweepFabric:
         seed: Optional[int] = None,
         slots: int = 8,
         staged=None,
+        speculate_k: int = 0,
+        draft_layers: Optional[int] = None,
         result_cb=None,
         trial_ids: Optional[Sequence[int]] = None,
         stop_event=None,
@@ -202,6 +204,8 @@ class SweepFabric:
                 seed=seed,
                 slots=slots,
                 staged=staged,
+                speculate_k=speculate_k,
+                draft_layers=draft_layers,
                 result_cb=cb,
                 trial_ids=[ids[p] for p in sub],
                 stop_event=stop_event,

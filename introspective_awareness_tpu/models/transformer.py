@@ -219,6 +219,58 @@ def merge_chunk(cache: KVCache, cfg: ModelConfig, page=None) -> KVCache:
     )
 
 
+def merge_chunk_compact(cache: KVCache, cfg: ModelConfig) -> KVCache:
+    """Fold the chunk ring into the merged tier COMPACTED per row.
+
+    The speculative decode chunk leaves holes in its ring — rejected draft
+    slots invalidated via ``rvalid`` — so the page-granular ``merge_chunk``
+    would carry those holes into the merged tier forever and the buffer
+    would have to be sized for ``rounds * (k+1)`` slots per chunk instead
+    of tokens actually emitted (a (k+1)x attention-width tax on every later
+    decode step). This variant scatters each row's VALID ring slots to the
+    row's next free merged positions (``mvalid.sum`` — compaction keeps
+    valid slots contiguous, so the count IS the write cursor), keeping the
+    merged tier exactly as wide as the non-speculative plan: one slot per
+    emitted token.
+
+    Chronological order of valid slots is preserved (the scatter rank is a
+    cumsum), and dropped slots never land, so later attention reads see the
+    same values in the same reduction order as the page merge — the greedy
+    bit-identity argument is unchanged. Resets both ``rlen`` and ``rvalid``
+    (the page merge only needs ``rlen``; here the holes must not leak into
+    the next chunk's fresh ring)."""
+    L, RR, B = cache.rk.shape[:3]
+    vd = cache.v.shape[-1]
+    M = cache.mvalid.shape[1]
+    rows = jnp.arange(B, dtype=jnp.int32)
+    mcount = cache.mvalid.sum(axis=1).astype(jnp.int32)  # [B] write cursors
+    valid = (
+        jnp.arange(RR, dtype=jnp.int32)[None, :] < cache.rlen
+    ) & cache.rvalid
+    rank = jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
+    dest = jnp.where(valid, mcount[:, None] + rank, M)  # M = mode="drop"
+    # Flat [L, P*ch, B, ...] views; advanced indices (dest, rows) sit on
+    # adjacent axes so the scatter stays one op per tensor.
+    mk = cache.mk.reshape((L, M) + cache.mk.shape[3:])
+    new_mk = mk.at[:, dest, rows[:, None]].set(
+        jnp.swapaxes(cache.rk, 1, 2).astype(mk.dtype), mode="drop"
+    ).reshape(cache.mk.shape)
+    if vd:
+        mv = cache.mv.reshape((L, M) + cache.mv.shape[3:])
+        new_mv = mv.at[:, dest, rows[:, None]].set(
+            jnp.swapaxes(cache.rv, 1, 2).astype(mv.dtype), mode="drop"
+        ).reshape(cache.mv.shape)
+    else:
+        new_mv = cache.mv
+    return cache._replace(
+        mk=new_mk, mv=new_mv,
+        mvalid=cache.mvalid.at[rows[:, None], dest].set(True, mode="drop"),
+        mpos=cache.mpos.at[rows[:, None], dest].set(cache.rpos, mode="drop"),
+        rlen=jnp.int32(0),
+        rvalid=jnp.ones_like(cache.rvalid),
+    )
+
+
 def reset_slots(cache: KVCache, reset_mask, prefix_len: int) -> KVCache:
     """Invalidate per-row decode state for slots about to be refilled.
 
@@ -708,7 +760,8 @@ class ForwardResult(NamedTuple):
 @partial(
     jax.jit,
     static_argnames=(
-        "cfg", "use_cache", "capture", "logits_mode", "is_prefill", "sp_mesh"
+        "cfg", "use_cache", "capture", "logits_mode", "is_prefill", "sp_mesh",
+        "layer_limit",
     ),
     # The KV cache is consumed and replaced every step; donation lets XLA
     # update it in place instead of holding two full [L,B,T,KVH,D] copies.
@@ -731,6 +784,7 @@ def forward(
     logits_mode: str = "last",  # "last" | "all" | "none" | "hidden"
     is_prefill: bool = False,
     sp_mesh=None,  # jax.sharding.Mesh with a seq axis > 1 → ring attention
+    layer_limit: int = 0,  # decode-only: run layers [0, layer_limit) then head
 ) -> ForwardResult:
     """One traced forward covering extraction, prefill, decode, and
     pipeline stages.
@@ -750,6 +804,12 @@ def forward(
       local layers; ``layer_offset`` (may be traced, e.g. stage *
       layers-per-stage) keeps steering layer gating and sliding-window
       periodicity on GLOBAL layer indices. No-cache only.
+    - ``layer_limit=D`` (decode only): early-exit draft forward — run just
+      the first D layers and apply the REAL final norm + LM head to the
+      layer-D residual (weight-sharing self-speculation; no separate draft
+      model). Ring KV is written for layers < D only; the caller must
+      overwrite those slots with a full verify pass (which rewrites every
+      layer) before any full-depth forward reads them.
     - ``sp_mesh``: a mesh whose ``seq`` axis is > 1 routes S > 1 attention
       through ring attention (ops/ring.py) — the chunk's Q/K/V shard over
       the sequence axis and K/V rotate over ICI, so long-context prefill and
@@ -761,6 +821,8 @@ def forward(
     dtype = params["embed"].dtype
     if h0 is not None:
         assert not use_cache, "pipeline stage form is no-cache"
+    if layer_limit:
+        assert use_cache and not is_prefill, "layer_limit is decode-only"
 
     h = embed_tokens(params, cfg, ids) if h0 is None else h0.astype(dtype)
 
@@ -1285,6 +1347,11 @@ def forward(
         caps = []
         for stack, lo, hi, moe in groups:
             for j, l in enumerate(range(lo, hi)):
+                if layer_limit and l >= layer_limit:
+                    # Early-exit draft: deeper layers are skipped entirely
+                    # (their ring slots stay stale until the verify pass
+                    # rewrites the whole window).
+                    continue
                 xs = {
                     "p": jax.tree.map(lambda p: p[j], stack),
                     "layer_id": layer_ids[l],

@@ -42,7 +42,11 @@ from introspective_awareness_tpu.obs.ledger import (
     Span,
     load_ledger,
 )
-from introspective_awareness_tpu.obs.pipeline import PipelineGauges, StagedGauges
+from introspective_awareness_tpu.obs.pipeline import (
+    PipelineGauges,
+    SpecGauges,
+    StagedGauges,
+)
 from introspective_awareness_tpu.obs.recovery import RecoveryGauges
 from introspective_awareness_tpu.obs.preflight import (
     AutotuneResult,
@@ -85,6 +89,7 @@ __all__ = [
     "NullLedger",
     "PHASES",
     "PipelineGauges",
+    "SpecGauges",
     "ProgressTracker",
     "RecoveryGauges",
     "StagedGauges",

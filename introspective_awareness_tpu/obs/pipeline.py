@@ -147,3 +147,47 @@ class StagedGauges:
                 str(k): int(v) for k, v in sorted(self.suffix_buckets.items())
             },
         }
+
+
+@dataclass
+class SpecGauges:
+    """Gauges for self-speculative decode chunks (``speculate_k > 0``).
+
+    - ``spec_acceptance_rate`` — accepted drafts / proposed drafts across
+      every live slot-round. The headline quality signal: 1.0 means the
+      early-exit drafter always agreed with the full model, 0.0 means every
+      round degenerated to one verified token (non-speculative throughput
+      paid at draft+verify cost).
+    - ``spec_tokens_per_round`` — emitted tokens per live slot-round
+      (1 .. k+1). The realized speedup lever: a round costs k draft
+      forwards at draft_layers/n_layers depth plus ONE full verify, so
+      tokens/round > 1 + k * draft_frac is the break-even line.
+    """
+
+    accepted: int = 0
+    drafted: int = 0
+    emitted: int = 0
+    live_rounds: int = 0
+
+    def chunk(
+        self, accepted: int, drafted: int, emitted: int, live_rounds: int
+    ) -> None:
+        """Account one processed speculative chunk's device counters."""
+        self.accepted += accepted
+        self.drafted += drafted
+        self.emitted += emitted
+        self.live_rounds += live_rounds
+
+    def as_stats(self) -> dict:
+        return {
+            "spec_accepted_total": int(self.accepted),
+            "spec_drafted_total": int(self.drafted),
+            "spec_acceptance_rate": (
+                round(self.accepted / self.drafted, 4)
+                if self.drafted else None
+            ),
+            "spec_tokens_per_round": (
+                round(self.emitted / self.live_rounds, 4)
+                if self.live_rounds else None
+            ),
+        }

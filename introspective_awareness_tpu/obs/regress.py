@@ -62,6 +62,13 @@ def _fabric_evals(doc: dict) -> Optional[float]:
     return fab.get("aggregate_evals_per_s")
 
 
+def _spec_steps(doc: dict) -> Optional[float]:
+    spec = doc.get("speculative") or {}
+    if spec.get("skipped"):
+        return None
+    return spec.get("speculative_decode_steps_per_s")
+
+
 HEADLINES: tuple = (
     ("evals_per_sec_chip", _value, True, 0.10, 0.0),
     ("decode_steps_per_sec", _decode_steps, True, 0.15, 0.0),
@@ -72,6 +79,11 @@ HEADLINES: tuple = (
     # CPU smoke, so thread scheduling adds noise throughput metrics above
     # don't see. Skipped (not failed) against history predating the section.
     ("fabric_aggregate_evals_per_s", _fabric_evals, True, 0.25, 0.0),
+    # Self-speculative decode rate from the bench's "speculative" section
+    # (decode-step-equivalent tokens/s per slot on the speculative leg).
+    # History-tolerant like fabric: rounds predating the section simply
+    # don't carry the metric, so the gate reports "skipped", never a fail.
+    ("speculative_decode_steps_per_s", _spec_steps, True, 0.20, 0.0),
 )
 
 
@@ -206,6 +218,9 @@ def inject_regression(history: list[tuple[Optional[dict], Any]],
     if isinstance(cur.get("fabric"), dict) and \
             cur["fabric"].get("aggregate_evals_per_s"):
         cur["fabric"]["aggregate_evals_per_s"] *= factor
+    if isinstance(cur.get("speculative"), dict) and \
+            cur["speculative"].get("speculative_decode_steps_per_s"):
+        cur["speculative"]["speculative_decode_steps_per_s"] *= factor
     return cur
 
 

@@ -59,6 +59,22 @@ def _check(cfg: ModelConfig, mesh: Mesh, batch: int, n_micro: int) -> int:
     return n_stages
 
 
+def _manual_axes(mesh: Mesh) -> frozenset:
+    """Mesh axes the pipeline shard_map is manual over.
+
+    On TPU the body is manual over ``pipe`` only and GSPMD shards the
+    remaining auto axes (batch/vocab) as usual. The CPU backend cannot
+    lower that partial-manual program: GSPMD rejects the stage schedule
+    (PartitionId "ambiguous" errors, IsManualSubgroup CHECK-failures in
+    hlo_sharding_util) and Shardy miscomputes AD-residual shapes under
+    partial-manual scans. Going fully manual on CPU sidesteps the SPMD
+    partitioner entirely — every non-pipe axis sees replicated data, which
+    only costs redundant compute on the host-platform test mesh."""
+    if mesh.devices.flat[0].platform == "cpu":
+        return frozenset(mesh.axis_names)
+    return frozenset({PIPE_AXIS})
+
+
 @partial(jax.jit, static_argnames=("cfg", "mesh", "n_micro"))
 def pipeline_hidden(
     params: dict,
@@ -113,22 +129,34 @@ def pipeline_hidden(
     trunk = params["layers"]
     others = {k: v for k, v in params.items() if k != "layers"}
     l_per_stage = cfg.n_layers // n_stages
+    # Stage index as DATA, not lax.axis_index: with only ``pipe`` manual,
+    # the remaining auto axes go through the SPMD partitioner, which lowers
+    # axis_index to a PartitionId HLO it then rejects as ambiguous (the CPU
+    # backend errors outright). An arange sharded over pipe hands each stage
+    # its own index as a [1] slice with no collective involved.
+    stage_ids = jnp.arange(n_stages, dtype=jnp.int32)
 
     @partial(
         compat.shard_map,
         mesh=mesh,
-        axis_names=frozenset({PIPE_AXIS}),
+        axis_names=_manual_axes(mesh),
+        # AD of the scan introduces residual carries whose inferred
+        # replication types trip the static rep checker (a tracing-time
+        # verifier only — the compiled program is unchanged); jax's own
+        # error message prescribes disabling it.
+        check_rep=False,
         # The trunk's leading (layer) dim splits over pipe; everything else
         # is replicated over pipe and left to GSPMD on the auto axes.
         in_specs=(
             jax.tree.map(lambda _: P(PIPE_AXIS), trunk),
+            P(PIPE_AXIS),
             P(), P(), P(), jax.tree.map(lambda _: P(), others),
             jax.tree.map(lambda _: P(), steerm),
         ),
         out_specs=P(),
     )
-    def run(trunk_local, h0m, maskm, posm, others, steerm):
-        p = lax.axis_index(PIPE_AXIS)
+    def run(trunk_local, stage_ids_local, h0m, maskm, posm, others, steerm):
+        p = stage_ids_local[0]
         stage_params = dict(others, layers=trunk_local)
         offset = p * l_per_stage
 
@@ -168,7 +196,7 @@ def pipeline_hidden(
             PIPE_AXIS,
         )
 
-    outs = run(trunk, h0m, maskm, posm, others, steerm)
+    outs = run(trunk, stage_ids, h0m, maskm, posm, others, steerm)
     return outs.reshape(B, S, H)
 
 

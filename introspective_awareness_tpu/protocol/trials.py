@@ -36,6 +36,8 @@ def run_trial_pass(
     debug: bool = False,
     scheduler: str = "batch",
     staged: bool = False,
+    speculate_k: int = 0,
+    draft_layers: Optional[int] = None,
     grade_pool=None,
     journal=None,
     pass_key: Optional[str] = None,
@@ -58,6 +60,9 @@ def run_trial_pass(
     identical greedy results, rows freed at EOS instead of at batch end.
     ``staged=True`` (continuous only) overlaps admission prefill with
     decode via staged suffix prefill — also output-identical.
+    ``speculate_k``/``draft_layers`` (continuous only) switch decode to
+    self-speculative multi-token rounds — greedy bit-identical,
+    temperature>0 distribution-identical on the same PRNG streams.
     """
     if trial_type not in TRIAL_TYPES:
         raise ValueError(f"unknown trial_type {trial_type!r} (expected {TRIAL_TYPES})")
@@ -72,7 +77,8 @@ def run_trial_pass(
             lambda _lf, c: vectors[c],
             max_new_tokens=max_new_tokens, temperature=temperature,
             batch_size=batch_size, seed=seed, scheduler="continuous",
-            staged=staged, grade_pool=grade_pool,
+            staged=staged, speculate_k=speculate_k,
+            draft_layers=draft_layers, grade_pool=grade_pool,
             journal=journal, pass_key=pass_key,
             stop_event=stop_event, faults=faults, trace=trace,
             fabric=fabric,
@@ -141,6 +147,8 @@ def run_grid_pass(
     seed: Optional[int] = None,
     scheduler: str = "batch",
     staged: bool = False,
+    speculate_k: int = 0,
+    draft_layers: Optional[int] = None,
     grade_pool=None,
     journal=None,
     pass_key: Optional[str] = None,
@@ -192,6 +200,13 @@ def run_grid_pass(
     :class:`~introspective_awareness_tpu.obs.ChunkTrace`; continuous only)
     records per-chunk dispatch/land/harvest events for the flight-recorder
     timeline and attribution.
+
+    ``speculate_k``/``draft_layers`` (continuous only) run decode in
+    self-speculative multi-token rounds (runtime.generate). Greedy trials
+    are bit-identical to non-speculative; temperature>0 trials stay
+    distribution-identical on the SAME per-trial PRNG streams but consume
+    those streams at a different rate — a resumed sweep must keep the same
+    speculation config for replayed/remainder bit-identity.
 
     ``fabric`` (a :class:`~introspective_awareness_tpu.fabric.SweepFabric`;
     continuous only) drains the pass through N replica runners instead of
@@ -329,6 +344,8 @@ def run_grid_pass(
                     seed=seed,
                     slots=batch_size,
                     staged=staged,
+                    speculate_k=speculate_k,
+                    draft_layers=draft_layers,
                     result_cb=result_cb,
                     # The fabric always needs the global stream ids (its
                     # leases are subsets); solo runs only need them when a

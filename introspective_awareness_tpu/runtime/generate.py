@@ -31,6 +31,7 @@ from introspective_awareness_tpu.models.transformer import (
     init_cache,
     make_positions,
     merge_chunk,
+    merge_chunk_compact,
     merge_ring,
     merge_suffix_slots,
     reset_slots,
@@ -96,6 +97,42 @@ def _chunk_plan(max_new_tokens: int) -> tuple[int, int]:
     n_chunks = -(-steps_total // RING_CHUNK) if steps_total else 0
     ch = -(-steps_total // n_chunks) if n_chunks else 1
     return n_chunks, ch
+
+
+def _spec_chunk_plan(max_new_tokens: int, k: int) -> tuple[int, int]:
+    """(n_chunks, rounds_per_chunk) for SPECULATIVE decode.
+
+    A speculation round (k drafts + one k+1-wide verify) consumes k+1 ring
+    slots and emits between 1 and k+1 tokens per live slot. The plan sizes
+    everything off the GUARANTEED minimum of one token per round, so the
+    page-recycling soundness argument is unchanged: a slot admitted at
+    chunk g emits >= rounds tokens per chunk and is budget-done within
+    n_chunks chunks. Ring capacity per chunk is rounds * (k + 1) — a
+    (k+1)x ring (only), paid for with up to (k+1)x fewer full-depth
+    dispatches; the merged tier stays at non-speculative width because
+    ``merge_chunk_compact`` drops the holes (see ``_spec_merged_pages``)."""
+    steps_total = max_new_tokens - 1
+    # Keep the ring at ~RING_CHUNK slots (rounds * (k+1) ≈ RING_CHUNK):
+    # every attention read in the chunk scans the full ring width, so a
+    # wider ring taxes all k+2 forwards per round. Measured on CPU this
+    # tax beats the host round-trips saved by packing more rounds per
+    # chunk (rounds ∈ {4, 8, 16} within noise at k=3; 32 clearly worse).
+    rounds = max(1, RING_CHUNK // (k + 1))
+    rounds = min(rounds, steps_total) if steps_total else 1
+    n_chunks = -(-steps_total // rounds) if steps_total else 0
+    return n_chunks, rounds
+
+
+def _spec_merged_pages(max_new_tokens: int, ring_len: int) -> int:
+    """Merged pages for SPECULATIVE decode: sized by tokens EMITTED, not by
+    chunks dispatched. ``merge_chunk_compact`` scatters only the accepted
+    ring slots to each row's next free merged positions, so a row's merged
+    footprint over its whole tenancy is exactly its emitted tokens
+    (<= steps_total) — the same width the non-speculative plan pins. Sizing
+    by ``n_chunks * ring`` instead (the page-recycling rule) would tax
+    every later attention read with (k+1)x dead width."""
+    steps_total = max_new_tokens - 1
+    return -(-steps_total // ring_len) if steps_total and ring_len else 0
 
 
 def _split_spans(total: int, chunk: Optional[int]) -> tuple[tuple[int, int], ...]:
@@ -612,7 +649,7 @@ def _stop_hit(stop: jax.Array, tail: jax.Array) -> jax.Array:
     jax.jit,
     static_argnames=(
         "cfg", "slots", "suffix_len", "max_new_tokens", "stop_width",
-        "with_prefix",
+        "with_prefix", "speculate_k",
     ),
 )
 def scheduler_init(
@@ -625,6 +662,7 @@ def scheduler_init(
     max_new_tokens: int,  # queue-wide max budget; sizes the chunk plan
     stop_width: int = 0,  # Ls of the stop-seq table (0 = no stop matching)
     with_prefix: bool = False,  # also return the batch-1 prefix KV (staged)
+    speculate_k: int = 0,  # > 0: size the ring/pages for speculative chunks
 ) -> tuple:
     """Build the persistent slot cache + empty slot state.
 
@@ -643,7 +681,14 @@ def scheduler_init(
     L = cfg.n_layers
     dtype = params["embed"].dtype
     H = params["embed"].shape[1]
-    n_chunks, ch = _chunk_plan(max_new_tokens)
+    if speculate_k:
+        n_chunks, rounds = _spec_chunk_plan(max_new_tokens, speculate_k)
+        ch = rounds * (speculate_k + 1)  # ring slots per chunk, incl. holes
+        # Compacting merge: pages hold emitted tokens, not chunk slots.
+        pages = _spec_merged_pages(max_new_tokens, ch)
+    else:
+        n_chunks, ch = _chunk_plan(max_new_tokens)
+        pages = n_chunks
 
     pcache = init_cache(cfg, 1, P0, dtype)
     r0 = forward(
@@ -654,7 +699,7 @@ def scheduler_init(
 
     T = P0 + suffix_len
     cache = init_cache(
-        cfg, B, T, dtype, ring_len=ch, merged_pages=n_chunks
+        cfg, B, T, dtype, ring_len=ch, merged_pages=pages
     )
 
     def put_prefix(dst, src):
@@ -673,7 +718,7 @@ def scheduler_init(
         length=jnp.int32(P0),
         # Pin the merged write-count gate open: with recycled pages the
         # high-water mark is meaningless and mvalid alone decides validity.
-        mlen=jnp.int32(n_chunks * ch),
+        mlen=jnp.int32(pages * ch),
     )
     # Same rematerialization hazard as generate_tokens_prefix: force the
     # broadcast cache to exist once, not per-layer inside the decode loop.
@@ -1062,4 +1107,242 @@ def scheduler_decode_chunk(
         prev=prev, done=done, n_emitted=n_emitted, keydata=keydata, tail=tail
     )
     flags = jnp.concatenate([done.astype(jnp.int32), n_emitted])
+    return cache, state, tokens, flags
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "rounds", "k", "draft_layers"),
+    donate_argnames=("cache", "state"),
+)
+def scheduler_decode_chunk_speculate(
+    params: dict,
+    cfg: ModelConfig,
+    cache,
+    state: SlotState,
+    spec: SchedSpec,
+    page: jax.Array,  # int32 — merged page to fold this chunk into
+    *,
+    rounds: int,
+    k: int,
+    draft_layers: int,
+) -> tuple:
+    """Self-speculative variant of ``scheduler_decode_chunk``: ``rounds``
+    rounds of (k early-exit drafts + one k+1-wide full verify) per chunk.
+
+    Each round the first ``draft_layers`` layers + the real LM head propose
+    k tokens sequentially (per-slot SteerSpec applies inside the truncated
+    trunk, so injection at a steer layer < draft_layers shapes the drafts
+    exactly as it shapes the verified model); one full-depth S=k+1 forward
+    then scores all k+1 positions at once. The longest draft prefix
+    matching the verify distribution is accepted plus one correction/bonus
+    token, so every round emits 1..k+1 tokens per live slot:
+
+    - temperature 0: acceptance is argmax-prefix matching — emitted tokens
+      are BIT-IDENTICAL to non-speculative decode (verify logits come from
+      the same full model; row-independent per-position reductions make the
+      k+1-wide forward equal k sequential steps, the same cross-shape
+      identity the staged-admission path already relies on).
+    - temperature > 0: standard speculative rejection sampling (accept
+      d ~ q iff u < p(d)/q(d); residual norm(max(p-q,0)); bonus from p) —
+      DISTRIBUTION-identical to non-speculative, not bit-identical (the
+      per-slot key chain advances by draws, not steps).
+
+    Draft forwards write ring KV for layers < draft_layers only; the verify
+    pass rewrites the whole k+1 window for every layer, and rejected slots
+    are invalidated afterwards (``rvalid``), which is bit-neutral under the
+    masked-softmax exact-zero property. EOS/stop/budget clamp the accepted
+    span BEFORE emission, so no token ever lands past a terminal token or a
+    slot's budget mid-round.
+
+    Returns tokens ``[B, rounds*(k+1)]`` FRONT-PACKED per row (col count in
+    flags) and a ``[3B + 2]`` flags vector: ``[done | n_emitted |
+    emitted_this_chunk | accepted_total, drafted_total]`` — one host copy
+    per chunk, same as the non-speculative contract."""
+    B = state.prev.shape[0]
+    W = rounds * (k + 1)
+    steer_decode = SteerSpec(
+        state.steer_layer,
+        state.steer_strength,
+        state.steer_vectors,
+        jnp.ones((B, 1), jnp.float32),
+    )
+    stop = spec.stop_seqs
+    use_stop = stop is not None and stop.shape[0] > 0
+    tokens0 = jnp.full((B, W), spec.pad_id, jnp.int32)
+    rows = jnp.arange(B)
+    idx = jnp.arange(k + 1, dtype=jnp.int32)
+
+    def split_keys(keydata):
+        keys = jax.random.wrap_key_data(keydata)
+        nk = jax.vmap(lambda kk: jax.random.split(kk))(keys)
+        return nk[:, 0], jax.random.key_data(nk[:, 1])
+
+    def round_body(_, carry):
+        (cache, prev, done, n_emitted, keydata, tokens, wcur, tail,
+         acc_total, drf_total) = carry
+        alive = ~done
+        am1 = alive.astype(jnp.int32)[:, None]
+        base_pos = state.true_len + n_emitted - 1
+        rlen0 = cache.rlen
+
+        # Draft: k sequential early-exit forwards. Their (partial-depth)
+        # ring writes land in the real ring as scratch — the verify pass
+        # below rewrites the same slots at full depth before any full-depth
+        # attention reads them.
+        drafts, dlogits = [], []
+        d_prev, dcache = prev, cache
+        for j in range(k):
+            out = forward(
+                params, cfg, d_prev[:, None], am1, (base_pos + j)[:, None],
+                cache=dcache, steer=steer_decode, use_cache=True,
+                logits_mode="last", layer_limit=draft_layers,
+            )
+            dcache = out.cache
+            d, keydata = _slot_sample(out.logits, keydata, spec.temperature)
+            d = jnp.where(done, spec.pad_id, d)
+            d_prev = d
+            drafts.append(d)
+            dlogits.append(out.logits)
+        drafts = jnp.stack(drafts, axis=1)  # [B, k]
+        dlogits = jnp.stack(dlogits, axis=1)  # [B, k, V]
+
+        # Verify: rewind the ring cursor and score [prev, d1..dk] in one
+        # full-depth forward (causal-within-chunk ring masking).
+        vcache = dcache._replace(rlen=rlen0)
+        ids_v = jnp.concatenate([prev[:, None], drafts], axis=1)
+        pos_v = base_pos[:, None] + idx[None, :]
+        out_v = forward(
+            params, cfg, ids_v, jnp.broadcast_to(am1, (B, k + 1)), pos_v,
+            cache=vcache, steer=steer_decode, use_cache=True,
+            logits_mode="all",
+        )
+        vlogits = out_v.logits  # [B, k+1, V]
+        cache = out_v.cache
+
+        def greedy(vlogits, dlogits, drafts, keydata):
+            t = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, k+1]
+            match = drafts == t[:, :k]
+            a = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+            corr = jnp.take_along_axis(t, a[:, None], axis=1)[:, 0]
+            return a, corr, keydata
+
+        def rejection(vlogits, dlogits, drafts, keydata):
+            T = jnp.maximum(spec.temperature, 1e-6)
+            p = jax.nn.softmax(vlogits / T, axis=-1)
+            q = jax.nn.softmax(dlogits / T, axis=-1)
+            pd = jnp.take_along_axis(
+                p[:, :k], drafts[..., None], axis=-1
+            )[..., 0]
+            qd = jnp.take_along_axis(q, drafts[..., None], axis=-1)[..., 0]
+            uk, keydata = split_keys(keydata)
+            u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(uk)
+            accept = u * jnp.maximum(qd, 1e-20) <= pd
+            a = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+            # Correction at position a: norm(max(p - q, 0)); past the last
+            # draft (a == k) q extends with zeros, so the residual reduces
+            # to the model distribution — the standard bonus token.
+            qe = jnp.concatenate([q, jnp.zeros_like(p[:, :1])], axis=1)
+            p_sel = jnp.take_along_axis(p, a[:, None, None], axis=1)[:, 0]
+            q_sel = jnp.take_along_axis(qe, a[:, None, None], axis=1)[:, 0]
+            resid = jnp.maximum(p_sel - q_sel, 0.0)
+            rnorm = resid.sum(axis=-1, keepdims=True)
+            dist = jnp.where(
+                rnorm > 0, resid / jnp.maximum(rnorm, 1e-20), p_sel
+            )
+            ck, keydata = split_keys(keydata)
+            g = jax.vmap(
+                lambda kk, dd: jax.random.gumbel(kk, dd.shape, dd.dtype)
+            )(ck, dist)
+            corr = jnp.argmax(
+                jnp.log(jnp.maximum(dist, 1e-30)) + g, axis=-1
+            ).astype(jnp.int32)
+            return a, corr, keydata
+
+        a, corr, keydata = lax.cond(
+            spec.temperature > 0, rejection, greedy,
+            vlogits, dlogits, drafts, keydata,
+        )
+
+        # Candidate emissions [d1..da, corr]; clamp at the FIRST terminal
+        # token (EOS / stop-seq / budget) so the terminal token itself is
+        # emitted and nothing after it (non-speculative semantics).
+        drafts_ext = jnp.concatenate(
+            [drafts, jnp.full((B, 1), spec.pad_id, jnp.int32)], axis=1
+        )
+        cand = jnp.where(idx[None, :] == a[:, None], corr[:, None], drafts_ext)
+        is_end = jnp.isin(cand, spec.eos_ids) | (
+            (n_emitted[:, None] + idx[None, :] + 1) >= state.budget[:, None]
+        )
+        if use_stop:
+            cur, tails = tail, []
+            for j in range(k + 1):
+                cur = jnp.concatenate([cur[:, 1:], cand[:, j : j + 1]], axis=1)
+                tails.append(cur)
+            tails = jnp.stack(tails, axis=1)  # [B, k+1, Ls]
+            hit = jax.vmap(
+                lambda tl: _stop_hit(stop, tl), in_axes=1, out_axes=1
+            )(tails)
+            is_end = is_end | hit
+        in_cand = idx[None, :] <= a[:, None]
+        ended = is_end & in_cand
+        any_end = jnp.any(ended, axis=1)
+        c_end = jnp.where(any_end, jnp.argmax(ended, axis=1) + 1, k + 2)
+        c_eff = jnp.minimum(a + 1, c_end).astype(jnp.int32)
+        c_eff = jnp.where(alive, c_eff, 0)
+
+        n_emitted = n_emitted + c_eff
+        last = jnp.take_along_axis(
+            cand, jnp.maximum(c_eff - 1, 0)[:, None], axis=1
+        )[:, 0]
+        prev = jnp.where(c_eff > 0, last, prev)
+        done = done | (alive & any_end)
+        if use_stop:
+            new_tail = jnp.take_along_axis(
+                tails, jnp.maximum(c_eff - 1, 0)[:, None, None], axis=1
+            )[:, 0]
+            tail = jnp.where((c_eff > 0)[:, None], new_tail, tail)
+        # Front-pack this round's emissions; rejected columns index out of
+        # bounds and drop.
+        col = jnp.where(
+            idx[None, :] < c_eff[:, None], wcur[:, None] + idx[None, :], W
+        )
+        tokens = tokens.at[rows[:, None], col].set(cand, mode="drop")
+        wcur = wcur + c_eff
+        acc_total = acc_total + (a * alive.astype(jnp.int32)).sum()
+        drf_total = drf_total + k * alive.astype(jnp.int32).sum()
+
+        # Accepted tokens only: invalidate the rejected tail of the verify
+        # window (slot 0 = prev, slots 1..a = accepted drafts; the
+        # correction token's KV lands next round as its slot 0). Holes are
+        # bit-neutral under the masked-softmax exact-zero property.
+        ridx = jnp.arange(cache.rk.shape[1], dtype=jnp.int32)
+        jwin = ridx[None, :] - rlen0
+        keep = ~((jwin >= 0) & (jwin <= k)) | (jwin <= a[:, None])
+        cache = cache._replace(rvalid=cache.rvalid & keep)
+        return (cache, prev, done, n_emitted, keydata, tokens, wcur, tail,
+                acc_total, drf_total)
+
+    carry = (
+        cache, state.prev, state.done, state.n_emitted, state.keydata,
+        tokens0, jnp.zeros((B,), jnp.int32), state.tail,
+        jnp.int32(0), jnp.int32(0),
+    )
+    (cache, prev, done, n_emitted, keydata, tokens, wcur, tail,
+     acc_total, drf_total) = lax.fori_loop(0, rounds, round_body, carry)
+    if _use_merged(cfg):
+        # Compacting merge: only the ACCEPTED ring slots land, at each
+        # row's next free merged position, so the merged tier stays as
+        # wide as the non-speculative plan (one slot per emitted token)
+        # instead of carrying every hole forever. ``page`` is unused here
+        # — compaction is count-addressed, not page-addressed.
+        del page
+        cache = merge_chunk_compact(cache, cfg)
+    state = state._replace(
+        prev=prev, done=done, n_emitted=n_emitted, keydata=keydata, tail=tail
+    )
+    flags = jnp.concatenate([
+        done.astype(jnp.int32), n_emitted, wcur,
+        jnp.stack([acc_total, drf_total]),
+    ])
     return cache, state, tokens, flags

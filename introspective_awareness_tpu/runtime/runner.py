@@ -694,6 +694,8 @@ class ModelRunner:
         stop_event=None,
         faults=None,
         trace=None,
+        speculate_k: int = 0,
+        draft_layers: Optional[int] = None,
         **kw,
     ) -> list[str]:
         """Continuous-batching counterpart of
@@ -746,6 +748,15 @@ class ModelRunner:
         one ``max_new_tokens``, and truncating per-trial after the fact
         would change sampled text), preserving input order in the result.
 
+        ``speculate_k > 0`` runs decode chunks self-speculatively: the
+        first ``draft_layers`` layers (default ``n_layers // 2``) + the
+        shared LM head draft ``speculate_k`` tokens per slot, verified by
+        one full-depth k+1-wide forward (runtime.scheduler). Greedy text is
+        bit-identical to ``speculate_k=0``; temperature > 0 is
+        distribution-identical but draws a different key chain. The
+        fixed-batch fallback has no speculative path — an ineligible queue
+        decodes non-speculatively and a ledger event flags it.
+
         Greedy outputs are bit-identical to the batch path on an unsharded
         runner or a dp-only mesh (test_scheduler.py). Under tensor
         parallelism the scheduler's executables partition reductions
@@ -783,6 +794,16 @@ class ModelRunner:
         # More slots than trials just decodes permanently-empty rows; clamp
         # (costs a shape bucket only when the whole queue is this small).
         slots = max(1, min(slots, N))
+        speculate_k = int(speculate_k)
+        if speculate_k:
+            if draft_layers is None:
+                draft_layers = max(1, self.cfg.n_layers // 2)
+            draft_layers = int(draft_layers)
+            if not (0 < draft_layers < self.cfg.n_layers):
+                raise ValueError(
+                    f"draft_layers={draft_layers} must be in "
+                    f"(0, {self.cfg.n_layers}) when speculate_k > 0"
+                )
 
         rows = [self.tokenizer.encode(p) for p in prompts]
         L0 = 0
@@ -791,6 +812,13 @@ class ModelRunner:
                 rows, strength_arr, steering_start_positions
             )
         if L0 == 0:
+            if speculate_k:
+                # The fixed-batch executables have no speculative variant;
+                # surface the silent downgrade instead of claiming spec ran.
+                self.ledger.event(
+                    "speculation_unavailable_fallback",
+                    trials=N, speculate_k=speculate_k, model=self.model_name,
+                )
             # Fixed-batch fallback in slot-sized chunks. One batch call has
             # a single max_new_tokens, so a mixed-budget queue is grouped by
             # budget — one run of slot-sized batch calls per distinct budget
@@ -906,6 +934,8 @@ class ModelRunner:
                 trial_ids=trial_ids, stop_event=stop_event, faults=faults,
                 trace=trace,
                 replica=str(getattr(self, "replica_label", "0")),
+                speculate_k=speculate_k,
+                draft_layers=int(draft_layers) if speculate_k else 0,
             )
             done = [r for r in results if r is not None]
             span.add_evals(len(done))

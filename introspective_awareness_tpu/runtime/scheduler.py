@@ -70,13 +70,20 @@ import jax
 import numpy as np
 
 from introspective_awareness_tpu.models.config import ModelConfig
-from introspective_awareness_tpu.obs import NullLedger, PipelineGauges, StagedGauges
+from introspective_awareness_tpu.obs import (
+    NullLedger,
+    PipelineGauges,
+    SpecGauges,
+    StagedGauges,
+)
 from introspective_awareness_tpu.obs.registry import default_registry
 from introspective_awareness_tpu.runtime.generate import (
     SchedSpec,
     _chunk_plan,
+    _spec_chunk_plan,
     scheduler_admit,
     scheduler_decode_chunk,
+    scheduler_decode_chunk_speculate,
     scheduler_init,
     scheduler_refill,
     scheduler_stage,
@@ -182,6 +189,8 @@ def run_scheduled(
     faults=None,
     trace=None,
     replica: str = "0",
+    speculate_k: int = 0,
+    draft_layers: int = 0,
 ) -> tuple[list[np.ndarray], dict]:
     """Drain ``trials`` through ``slots`` decode rows; returns per-trial
     token arrays (input order, length = tokens actually emitted, final
@@ -235,6 +244,17 @@ def run_scheduled(
     ``replica`` labels this run's live-metrics series in the registry so
     concurrent sweep-fabric replicas stay distinguishable; single-replica
     runs land in the default ``replica="0"`` series.
+
+    ``speculate_k > 0`` switches decode chunks to self-speculative
+    multi-token rounds (``scheduler_decode_chunk_speculate``): the first
+    ``draft_layers`` layers + the shared LM head propose ``speculate_k``
+    tokens per slot, one full-depth k+1-wide verify accepts the longest
+    matching prefix. Greedy outputs are bit-identical to ``speculate_k=0``;
+    temperature > 0 is distribution-identical (rejection sampling) but not
+    bit-identical — resumed sweeps must keep the same speculation config
+    for reproducible merges. Host budget accounting uses the guaranteed
+    minimum of one emitted token per round, so the budget-horizon and
+    page-recycling arguments carry over unchanged.
     """
     ledger = ledger if ledger is not None else NullLedger()
     B = slots
@@ -245,8 +265,11 @@ def run_scheduled(
         return [], {"chunks": 0, "refills": 0, "mean_slot_occupancy": 0.0,
                     "padded_row_waste_steps": 0, "pipelined": bool(pipeline),
                     "staged": bool(staged), "interrupted": False,
+                    "speculate_k": int(speculate_k),
+                    "draft_layers": int(draft_layers) if speculate_k else 0,
                     **PipelineGauges().as_stats(0.0, 0),
-                    **StagedGauges().as_stats()}
+                    **StagedGauges().as_stats(),
+                    **SpecGauges().as_stats()}
     if trial_ids is not None and len(trial_ids) != N:
         raise ValueError("trial_ids must align with trials")
     Ss = int(trials[0].suffix_ids.shape[0])
@@ -259,7 +282,21 @@ def run_scheduled(
                 f"trial budget {t.budget} outside [1, {max_new_tokens}]"
             )
 
-    n_chunks, ch = _chunk_plan(max_new_tokens)
+    speculate_k = int(speculate_k)
+    if speculate_k:
+        if not (0 < draft_layers < cfg.n_layers):
+            raise ValueError(
+                f"speculate_k={speculate_k} needs 0 < draft_layers "
+                f"< n_layers={cfg.n_layers}, got {draft_layers}"
+            )
+        # `ch` doubles as the host-side per-chunk progress unit (budget
+        # horizon, waste accounting). A speculative chunk guarantees >= 1
+        # token per round, so rounds is the sound lower bound.
+        n_chunks, rounds = _spec_chunk_plan(max_new_tokens, speculate_k)
+        ch = rounds
+    else:
+        rounds = 0
+        n_chunks, ch = _chunk_plan(max_new_tokens)
     stop = None
     if stop_seqs is not None and len(stop_seqs) > 0:
         stop = jnp.asarray(np.asarray(stop_seqs, np.int32))
@@ -270,12 +307,13 @@ def run_scheduled(
             params, cfg, jnp.asarray(np.asarray(prefix_ids, np.int32)),
             slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
             stop_width=stop_width, with_prefix=True,
+            speculate_k=speculate_k,
         )
     else:
         cache, state = scheduler_init(
             params, cfg, jnp.asarray(np.asarray(prefix_ids, np.int32)),
             slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
-            stop_width=stop_width,
+            stop_width=stop_width, speculate_k=speculate_k,
         )
     spec = SchedSpec(
         temperature=jnp.float32(temperature),
@@ -322,6 +360,7 @@ def run_scheduled(
     refill_min = max(1, int(refill_frac * B))
     gauges = PipelineGauges()
     sgauges = StagedGauges()
+    pgauges = SpecGauges()
     # Staged-admission pool state. Staging runs in group-sized bites (one
     # refill hysteresis quantum — small groups keep the Sb buckets tight)
     # and stays `lookahead` admission waves ahead of demand, floored at one
@@ -382,6 +421,14 @@ def run_scheduled(
         labelnames=("replica",))
     m_final = _reg.counter(
         "iat_scheduler_trials_finalized_total", "trials finalized",
+        labelnames=("replica",))
+    m_spec_acc = _reg.gauge(
+        "iat_spec_acceptance_rate",
+        "accepted/drafted ratio over processed speculative chunks",
+        labelnames=("replica",))
+    m_spec_tok = _reg.gauge(
+        "iat_spec_tokens_per_round",
+        "emitted tokens per live speculation round",
         labelnames=("replica",))
 
     def _dispatch_refill() -> None:
@@ -540,9 +587,15 @@ def run_scheduled(
     def _dispatch_chunk() -> None:
         nonlocal cache, state, g, d_seq
         page = jnp.int32(g % n_chunks) if n_chunks else jnp.int32(0)
-        cache, state, toks, flags = scheduler_decode_chunk(
-            params, cfg, cache, state, spec, page, ch=ch
-        )
+        if speculate_k:
+            cache, state, toks, flags = scheduler_decode_chunk_speculate(
+                params, cfg, cache, state, spec, page,
+                rounds=rounds, k=speculate_k, draft_layers=draft_layers,
+            )
+        else:
+            cache, state, toks, flags = scheduler_decode_chunk(
+                params, cfg, cache, state, spec, page, ch=ch
+            )
         g += 1
         flags.copy_to_host_async()
         toks.copy_to_host_async()
@@ -567,7 +620,7 @@ def run_scheduled(
         if trace is not None:
             trace.landed(ev.kind, ev.seq, t0, t0 + wait_s)
         done = flags[:B] != 0
-        n_em = flags[B:]
+        n_em = flags[B : 2 * B]
         if ev.kind == "chunk":
             # Device-truth occupancy: a slot was live for this chunk iff it
             # was assigned at dispatch and not done at the preceding event.
@@ -577,10 +630,27 @@ def run_scheduled(
             chunks_done += 1
             m_chunks.inc(**_rl)
             m_occ.set(live / B, **_rl)
+            cnt = None
+            if speculate_k:
+                # Speculative [3B+2] flags: per-slot emitted counts gate the
+                # FRONT-PACKED token slab; the trailing pair holds the
+                # chunk's accepted/drafted totals (drafted / k = exact live
+                # slot-round count, so tokens-per-round is device truth).
+                cnt = flags[2 * B : 3 * B]
+                acc, drf = int(flags[3 * B]), int(flags[3 * B + 1])
+                pgauges.chunk(acc, drf, int(cnt.sum()), drf // speculate_k)
+                if pgauges.drafted:
+                    m_spec_acc.set(
+                        pgauges.accepted / pgauges.drafted, **_rl)
+                if pgauges.live_rounds:
+                    m_spec_tok.set(
+                        pgauges.emitted / pgauges.live_rounds, **_rl)
             for s in range(B):
                 ti = int(ev.owners[s])
                 if ti >= 0 and results[ti] is None:
-                    bufs[ti].append(toks[s])
+                    bufs[ti].append(
+                        toks[s, : int(cnt[s])] if cnt is not None else toks[s]
+                    )
             ledger.event(
                 "slot_occupancy",
                 chunk=chunks_done,
@@ -697,7 +767,10 @@ def run_scheduled(
         "pipelined": bool(pipeline),
         "staged": bool(staged),
         "interrupted": bool(interrupted),
+        "speculate_k": int(speculate_k),
+        "draft_layers": int(draft_layers) if speculate_k else 0,
         **gauges.as_stats(wall_s, chunks_done),
         **sgauges.as_stats(),
+        **pgauges.as_stats(),
     }
     return results, stats

@@ -6,7 +6,9 @@ round history with noise-tolerant thresholds (see
 ``introspective_awareness_tpu/obs/regress.py``) and exits:
 
 - 0 — verdict ``pass`` / ``improve`` / ``no_history`` (a CPU smoke has
-  no comparable TPU history; that is a pass, not a skip);
+  no comparable TPU history, or the trajectory is empty entirely; both
+  are a pass, not a skip — ``--seed-out`` captures the current doc as
+  the first round in the empty case);
 - 1 — verdict ``regress``;
 - 2 — usage / unreadable inputs.
 
@@ -61,10 +63,16 @@ def main(argv=None) -> int:
                          "the newest history round (expected exit: 1)")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="also write the full gate result JSON to this path")
+    ap.add_argument("--seed-out", default=None,
+                    help="on a no_history verdict, write the current doc "
+                         "here as the trajectory's seed round (wrapped "
+                         "{'n': 0, 'parsed': doc} like BENCH_r*.json)")
     args = ap.parse_args(argv)
 
     regress = _load_regress()
-    paths = (args.history if args.history
+    # `--history` with no paths is an EXPLICITLY empty trajectory (the
+    # no_history/seed path below); only an omitted flag globs the repo.
+    paths = (args.history if args.history is not None
              else sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json"))))
     history = []
     for p in paths:
@@ -75,9 +83,17 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         history.append((doc, n if n is not None else os.path.basename(p)))
-    if not history:
-        print("perf gate: no history files found", file=sys.stderr)
+    if not history and args.inject_regression:
+        # Self-test needs a round to degrade; an empty trajectory can't
+        # prove the regress path fires.
+        print("perf gate: no history files found to degrade", file=sys.stderr)
         return 2
+    if not history:
+        # First bench round of a fresh trajectory (or a fresh backend):
+        # nothing to regress against is a real, PASSING verdict — the
+        # current doc seeds the history the next run will be gated on.
+        print("perf gate: no history files found — current doc seeds the "
+              "trajectory", file=sys.stderr)
 
     if args.inject_regression:
         try:
@@ -104,6 +120,12 @@ def main(argv=None) -> int:
     if args.json_out:
         with open(args.json_out, "w", encoding="utf-8") as f:
             json.dump(result, f, indent=2)
+    if args.seed_out and result["verdict"] == "no_history":
+        with open(args.seed_out, "w", encoding="utf-8") as f:
+            json.dump({"n": 0, "cmd": "perf_gate --seed-out",
+                       "rc": 0, "parsed": current}, f, indent=2)
+        print(f"perf gate: seeded trajectory doc at {args.seed_out}",
+              file=sys.stderr)
     return 1 if result["verdict"] == "regress" else 0
 
 

@@ -80,6 +80,7 @@ def test_kernel_matches_oracle(L, B, S, T0, R, NH, KVH, D, kw):
     _case(L, B, S, T0, R, NH, KVH, D, **kw)
 
 
+@pytest.mark.slow  # generation-length identity; kernel-vs-oracle grid stays fast
 def test_flash_cached_generation_token_identity():
     """generate_tokens / generate_tokens_prefix produce IDENTICAL tokens with
     attn_impl=flash_cached (fused kernel decode) and attn_impl=xla."""

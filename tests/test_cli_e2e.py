@@ -118,6 +118,7 @@ def test_resume_skips_existing(sweep_out, tmp_path, capsys):
     assert before == after
 
 
+@pytest.mark.slow  # fast-lane anchor: test_grid_steering per-cell equivalence
 def test_fused_grid_matches_per_cell(tmp_path, monkeypatch):
     """--fuse-cells on packs all four cells' rows into shared batches: at
     temperature 0 every per-cell results.json (responses AND metrics) is
@@ -170,6 +171,7 @@ def test_fused_grid_matches_per_cell(tmp_path, monkeypatch):
     assert "evals_per_sec_per_chip" in man["timings"]
 
 
+@pytest.mark.slow  # mesh-fold behavior; test_pipeline covers the pp path
 def test_pp_folds_into_dp_on_eval_path(tmp_path, capsys):
     """--pp on the eval path folds into --dp instead of silently replicating
     sweep work across the pipe axis (pipeline parallelism serves the
@@ -189,6 +191,7 @@ def test_pp_folds_into_dp_on_eval_path(tmp_path, capsys):
     }
 
 
+@pytest.mark.slow  # fast-lane anchors: test_artifact_layout + resume tests
 def test_single_cell_and_overwrite(tmp_path):
     argv_base = [
         "--models", "tiny:3",
@@ -210,6 +213,7 @@ def test_single_cell_and_overwrite(tmp_path):
     assert (cell / "results.json").stat().st_mtime >= first
 
 
+@pytest.mark.slow  # heaviest e2e case (two co-resident runners, full sweep)
 def test_on_device_judge_coresidency(tmp_path):
     """Subject AND grader ModelRunners co-resident on the one mesh, through
     the real CLI path (--judge-backend on-device): the subject generates the

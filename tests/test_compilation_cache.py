@@ -12,6 +12,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 
 def _run_sweep(out_dir: Path, cache_dir: Path) -> dict:
     env = dict(os.environ)
@@ -45,6 +47,7 @@ def _run_sweep(out_dir: Path, cache_dir: Path) -> dict:
     return json.loads((out_dir / "tiny" / "run_manifest.json").read_text())
 
 
+@pytest.mark.slow  # two fresh subprocess sweeps (deliberate double compile)
 def test_warm_restart_skips_compile(tmp_path):
     cache = tmp_path / "xla-cache"
     cold = _run_sweep(tmp_path / "run1", cache)

@@ -63,6 +63,7 @@ def _cell_data(out_dir):
 # --- kill-and-resume through the real CLI -----------------------------------
 
 
+@pytest.mark.slow  # the fault-smoke CI job runs this flow at temperature 1.0
 @pytest.mark.parametrize("temperature", ["0.0", "1.0"])
 def test_kill_and_resume_bit_identical(tmp_path, temperature):
     """Crash after 2 decode chunks + a torn journal tail, then resume: every
@@ -436,6 +437,7 @@ def test_retry_after_header_parsing():
 # --- judge outage end-to-end: defer, finish, re-grade on resume -------------
 
 
+@pytest.mark.slow  # phase 2 of the fault-smoke CI job covers this e2e
 def test_judge_outage_defers_then_regrades_on_resume(tmp_path, monkeypatch, capsys):
     """Sweep with a dead judge finishes decode-complete (exit 0): grading is
     deferred to the journal, cells persist with keyword metrics, and the

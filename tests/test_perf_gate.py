@@ -1,0 +1,122 @@
+"""scripts/perf_gate.py: empty-trajectory seeding and headline coverage.
+
+The gate is stdlib-only and loaded by file path (the CI perf-gate job runs
+it without jax); these tests drive ``main(argv)`` the same way CI's shell
+steps do, against synthetic docs in tmp_path.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def gate():
+    spec = importlib.util.spec_from_file_location(
+        "iat_perf_gate", os.path.join(_REPO, "scripts", "perf_gate.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _doc(value=10.0, spec_steps=500.0):
+    return {
+        "metric": "injected-thought evals/sec/chip",
+        "value": value,
+        "unit": f"evals/s/chip (batch=8, bf16, 32 new tokens, cpu)",
+        "backend": "cpu",
+        "batch_sweep": [{"label": "bf16", "batch": 8,
+                         "decode_steps_per_sec": value * 3}],
+        "speculative": {
+            "speculative_decode_steps_per_s": spec_steps,
+            "outputs_identical": True,
+            "spec_acceptance_rate": 1.0,
+        },
+    }
+
+
+def test_empty_history_is_no_history_and_seeds(gate, tmp_path, capsys):
+    """An EMPTY trajectory (explicit ``--history`` with no files) must not
+    error: the verdict is no_history (exit 0) and ``--seed-out`` captures
+    the current doc as round 0 in the BENCH_r*.json wrapper shape."""
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps(_doc()))
+    seed = tmp_path / "seed.json"
+    out = tmp_path / "gate.json"
+    rc = gate.main([
+        "--history", "--current", str(cur),
+        "--seed-out", str(seed), "--json", str(out),
+    ])
+    assert rc == 0
+    result = json.loads(out.read_text())
+    assert result["verdict"] == "no_history"
+    assert result["n_history"] == 0
+    wrapped = json.loads(seed.read_text())
+    assert wrapped["n"] == 0
+    assert wrapped["parsed"]["value"] == 10.0
+
+
+def test_seed_not_written_when_history_comparable(gate, tmp_path):
+    """With a comparable round on file, the gate compares (verdict pass
+    here) and must NOT overwrite the seed path."""
+    hist = tmp_path / "BENCH_r01.json"
+    hist.write_text(json.dumps({"n": 1, "rc": 0, "parsed": _doc()}))
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps(_doc()))
+    seed = tmp_path / "seed.json"
+    rc = gate.main([
+        "--history", str(hist), "--current", str(cur),
+        "--seed-out", str(seed),
+    ])
+    assert rc == 0
+    assert not seed.exists()
+
+
+def test_empty_history_inject_regression_still_errors(gate):
+    """The regress self-test needs a round to degrade — an empty trajectory
+    cannot prove the gate fires, so it stays a usage error."""
+    assert gate.main(["--history", "--inject-regression"]) == 2
+
+
+def test_regression_fires_including_speculative_headline(gate, tmp_path):
+    """A halved current doc against real history must exit 1, and the
+    speculative decode headline must be among the regressed metrics (it is
+    history-tolerant, not toothless)."""
+    hist = tmp_path / "BENCH_r01.json"
+    hist.write_text(json.dumps({"n": 1, "rc": 0, "parsed": _doc()}))
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps(_doc(value=4.0, spec_steps=200.0)))
+    out = tmp_path / "gate.json"
+    rc = gate.main([
+        "--history", str(hist), "--current", str(cur), "--json", str(out),
+    ])
+    assert rc == 1
+    result = json.loads(out.read_text())
+    verdicts = {m["metric"]: m["verdict"] for m in result["metrics"]}
+    assert verdicts["speculative_decode_steps_per_s"] == "regress"
+
+
+def test_history_predating_speculative_section_skips_not_fails(gate, tmp_path):
+    """Rounds that predate the bench "speculative" section simply lack the
+    metric: the gate must skip it (no comparable history), never fail."""
+    old = _doc()
+    del old["speculative"]
+    hist = tmp_path / "BENCH_r01.json"
+    hist.write_text(json.dumps({"n": 1, "rc": 0, "parsed": old}))
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps(_doc()))
+    out = tmp_path / "gate.json"
+    rc = gate.main([
+        "--history", str(hist), "--current", str(cur), "--json", str(out),
+    ])
+    assert rc == 0
+    result = json.loads(out.read_text())
+    row = {m["metric"]: m for m in result["metrics"]}[
+        "speculative_decode_steps_per_s"
+    ]
+    assert row["verdict"] == "skipped"

@@ -84,8 +84,8 @@ def test_pipeline_loss_and_grads_match(setup):
 
     g_ref = jax.grad(next_token_loss)(params, cfg, ids, mask)
     g_pp = jax.grad(pipeline_next_token_loss)(params, cfg, ids, mask, mesh, 2)
-    flat_ref = jax.tree.leaves_with_path(g_ref)
-    flat_pp = dict(jax.tree.leaves_with_path(g_pp))
+    flat_ref = jax.tree_util.tree_leaves_with_path(g_ref)
+    flat_pp = dict(jax.tree_util.tree_leaves_with_path(g_pp))
     for path, leaf in flat_ref:
         np.testing.assert_allclose(
             np.asarray(flat_pp[path]), np.asarray(leaf),

@@ -83,6 +83,7 @@ def test_quantized_generation_runs():
     assert len(out) == 2
 
 
+@pytest.mark.slow  # full CLI sweep; quantized forward/generation tests stay fast
 def test_cli_quantization_flag(tmp_path):
     from introspective_awareness_tpu.cli.sweep import main
 

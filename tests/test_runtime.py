@@ -159,6 +159,7 @@ def test_prefix_cache_matches_full_prefill(monkeypatch):
     assert calls["prefix"] == 2
 
 
+@pytest.mark.slow  # composition case; prefix-cache and fp8 each tested fast solo
 def test_prefix_cache_composes_with_fp8_kv(monkeypatch):
     """Shared-prefix caching + fp8 KV cache compose. The two paths are NOT
     guaranteed bit-identical under fp8 — the prefix path's suffix chunk

@@ -93,6 +93,7 @@ def test_scheduler_matches_batch_and_prefix_paths(runner, runner_noprefix):
     assert sched == prefix == full
 
 
+@pytest.mark.slow  # budget matrix; mixed budgets stay fast in staged/speculative
 def test_scheduler_mixed_budgets_match_grouped_references(runner):
     """Per-trial budgets: every trial must equal the batch path run at
     exactly that trial's budget (grouped by budget — the only way the fixed

@@ -1,0 +1,216 @@
+"""Self-speculative decode: equivalence with the plain slot scheduler.
+
+The drafter (first ``draft_layers`` layers + the shared LM head) proposes
+``k`` tokens per slot per round; one full-depth forward verifies them. The
+contract under test:
+
+* temp 0 — BIT-identical text to ``speculate_k=0`` for every (k, D) and for
+  steering above AND below the draft cut (above-cut rows hide the injection
+  from the drafter, so acceptance collapses — correctness must not).
+* temp > 0 — distribution-identical via rejection sampling on the same
+  queue-indexed PRNG streams: slot-count invariant, seed-reproducible, and
+  the corrected draws follow the FULL model's distribution even when the
+  draft distribution is wildly different (steering above the cut).
+* per-trial budgets — a round that straddles a trial's budget is clamped
+  mid-speculation; text still matches the non-speculative scheduler.
+* no shared prefix — speculation quietly degrades to the fixed-batch
+  fallback (ledgered), never to wrong output.
+"""
+
+from collections import Counter
+
+import jax
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu import obs
+from introspective_awareness_tpu.models import (
+    ByteTokenizer,
+    init_params,
+    tiny_config,
+)
+from introspective_awareness_tpu.runtime import ModelRunner
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config()  # 4 layers: draft cuts at 1..3 all meaningful
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def runner(setup):
+    cfg, params = setup
+    return ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4,
+    )
+
+
+COMMON = "The quick brown fox jumps over the lazy dog. " * 4
+
+
+def _queue(n, hidden, lo_layer=1, hi_layer=3):
+    """n trials sharing the preamble; steer layers alternate BELOW
+    (``lo_layer``) and ABOVE (``hi_layer``) typical draft cuts, with a
+    strength-0 row every third trial."""
+    prompts, starts, strengths, layers = [], [], [], []
+    for i in range(n):
+        p = COMMON + f"Trial {i + 1}: report the injected thought" + "!" * (i % 3)
+        prompts.append(p)
+        if i % 3 == 2:
+            strengths.append(0.0)
+            starts.append(None)
+        else:
+            strengths.append(8.0 + i)
+            starts.append(len(p) - 8)
+        layers.append(lo_layer if i % 2 == 0 else hi_layer)
+    rng = np.random.default_rng(3)
+    vecs = [rng.standard_normal(hidden).astype(np.float32) * 4.0
+            for _ in range(n)]
+    return prompts, layers, vecs, strengths, starts
+
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+# D=1 is the degenerate all-above-cut column (acceptance ~0 everywhere);
+# D=3 already exercises steering below AND above the cut, so D=1 rides slow.
+@pytest.mark.parametrize(
+    "draft_layers", [pytest.param(1, marks=pytest.mark.slow), 3]
+)
+def test_greedy_bit_identity(runner, k, draft_layers):
+    """temp 0: speculation is an execution detail — text must be
+    bit-identical to the plain scheduler for every (k, D), with the queue
+    mixing steer layers below (high acceptance) and above (near-zero
+    acceptance) the draft cut."""
+    prompts, layers, vecs, strengths, starts = _queue(6, runner.cfg.hidden_size)
+    kw = dict(
+        max_new_tokens=12, temperature=0.0,
+        steering_start_positions=starts, seed=0, slots=3,
+    )
+    base = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, **kw
+    )
+    spec = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths,
+        speculate_k=k, draft_layers=draft_layers, **kw
+    )
+    assert spec == base
+
+
+def test_budget_exhaustion_mid_speculation(runner):
+    """Per-trial budgets that are NOT multiples of the round size force the
+    accept path to clamp candidates mid-round (c_eff = min(a+1, remaining));
+    every trial must still match the non-speculative scheduler exactly."""
+    N = 8
+    prompts, layers, vecs, strengths, starts = _queue(N, runner.cfg.hidden_size)
+    budgets = [3, 11, 6, 2, 9, 5, 11, 7]  # straddle k+1 = 5 round boundaries
+    kw = dict(
+        max_new_tokens=11, temperature=0.0,
+        steering_start_positions=starts, budgets=budgets, seed=0, slots=3,
+    )
+    base = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, **kw
+    )
+    spec = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, speculate_k=4, draft_layers=2, **kw
+    )
+    assert spec == base
+
+
+def test_sampled_slot_invariance_and_reproducibility(runner):
+    """temp > 0 with speculation on: every trial samples (and
+    rejection-samples) from its own queue-indexed PRNG stream, so the drawn
+    text cannot depend on the slot count, and the same seed must reproduce
+    the same text exactly."""
+    prompts, layers, vecs, strengths, starts = _queue(6, runner.cfg.hidden_size)
+    kw = dict(
+        max_new_tokens=10, temperature=0.9,
+        steering_start_positions=starts, seed=11,
+        speculate_k=3, draft_layers=2,
+    )
+    two = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, slots=2, **kw
+    )
+    four = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, slots=4, **kw
+    )
+    again = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, slots=2, **kw
+    )
+    assert two == four
+    assert two == again
+
+
+def test_sampled_distribution_matches_full_model(runner):
+    """temp 1 distribution check: steer ABOVE the draft cut with high
+    strength, so the full model's next-token distribution is peaked on
+    steered tokens while the drafter (blind to the injection) proposes from
+    a diffuse unsteered distribution. Rejection sampling must correct the
+    accepted draws back to the FULL distribution: the empirical first-token
+    distribution under speculation must match the non-speculative
+    scheduler's within sampling noise. A blind-accept bug would leave the
+    drafter's diffuse distribution — total variation near 1, not < 0.35."""
+    N, seeds = 6, 40
+    hidden = runner.cfg.hidden_size
+    prompts, _, vecs, _, starts = _queue(N, hidden)
+    layers = [3] * N  # all above any D <= 2 cut
+    strengths = [16.0] * N
+    starts = [len(p) - 8 for p in prompts]
+
+    def first_tokens(spec_k, dl):
+        counts: Counter = Counter()
+        for s in range(seeds):
+            out = runner.generate_grid_scheduled(
+                prompts, layers, vecs, strengths, max_new_tokens=3,
+                temperature=1.0, steering_start_positions=starts,
+                seed=100 + s, slots=N, speculate_k=spec_k, draft_layers=dl,
+            )
+            for i, text in enumerate(out):
+                gen = text[len(prompts[i]):]
+                counts[gen[:1]] += 1
+        return counts
+
+    base = first_tokens(0, None)
+    spec = first_tokens(2, 2)
+    n = sum(base.values())
+    assert n == sum(spec.values()) == N * seeds
+    tvd = 0.5 * sum(
+        abs(base[c] - spec[c]) / n for c in set(base) | set(spec)
+    )
+    assert tvd < 0.35, f"speculative sampling skewed the distribution: {tvd}"
+
+
+def test_no_shared_prefix_falls_back_and_ledgers(setup):
+    """A queue with no common token prefix cannot speculate (the slot
+    scheduler itself is prefix-keyed): the runner must fall back to the
+    fixed-batch path, emit ``speculation_unavailable_fallback``, and still
+    return the batch path's exact text."""
+    cfg, params = setup
+    ledger = obs.RunLedger()
+    runner = ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny-fb",
+        seq_multiple=16, batch_multiple=4, ledger=ledger,
+    )
+    prompts = [
+        "Alpha prompt, nothing shared here at all.",
+        "Zebra text: completely different opening.",
+        "Quartz! a third unrelated beginning.",
+    ]
+    rng = np.random.default_rng(5)
+    vecs = [rng.standard_normal(cfg.hidden_size).astype(np.float32) * 4.0
+            for _ in prompts]
+    layers, strengths = [1, 2, 1], [6.0, 7.0, 0.0]
+    kw = dict(max_new_tokens=8, temperature=0.0, seed=0)
+    spec = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, slots=2,
+        speculate_k=3, draft_layers=2, **kw
+    )
+    ref = runner.generate_batch_with_grid_steering(
+        prompts, layers, vecs, strengths, **kw
+    )
+    assert spec == ref
+    assert any(
+        e.get("name") == "speculation_unavailable_fallback"
+        for e in ledger.events
+    )

@@ -82,6 +82,7 @@ def test_staged_matches_sync_greedy_mixed_budgets(runner):
         assert staged == sync, f"staged admission diverged at slots={slots}"
 
 
+@pytest.mark.slow  # sampled-path anchors stay fast in test_scheduler/test_pipelined
 def test_staged_matches_sync_sampled(runner):
     """temp > 0: the per-trial PRNG is queue-indexed, so sampled text must
     be invariant to the slot count AND the admission mechanism — staging
@@ -125,6 +126,7 @@ def test_staged_chunk_size_invariance(runner, monkeypatch):
     assert coarse_staged == fine_sync
 
 
+@pytest.mark.slow  # invariance matrix; chunk-size invariance stays fast
 def test_staged_suffix_bucket_invariance(runner):
     """The bucket quantum only sets the padded stage width Sb: real tokens
     are left-packed into the Sb window and land at the same physical slots
@@ -188,6 +190,7 @@ def test_staged_stats_preserved(setup):
     assert s["stages"] == 0 and s["admits"] == 0
 
 
+@pytest.mark.slow  # fallback equivalence also covered by test_scheduler fallback
 def test_fallback_budget_grouping_matches_batch(runner):
     """No shared prefix => the scheduler falls back to fixed batches. With
     mixed budgets it must group trials by budget and match per-budget

@@ -150,7 +150,10 @@ def test_prefill_decode_matches_full_forward(cfg, params):
         )
 
 
-@pytest.mark.parametrize("variant", ["mha", "sliding", "mla"])
+@pytest.mark.parametrize(
+    "variant",
+    ["mha", pytest.param("sliding", marks=pytest.mark.slow), "mla"],
+)
 def test_decode_ring_merge_matches_full_forward(variant):
     """Multi-chunk decode: the ring fills up and merges into the main slot
     buffer every ``ring`` steps (runtime.generate's chunked loop calls
