@@ -970,6 +970,97 @@ def _fabric_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     return r
 
 
+def _coordinator_rpc_bench(n_trials: int = 512, lease_size: int = 8) -> dict:
+    """Control-plane microbench: in-process queue vs the RPC coordinator.
+
+    Drains the same ``PartitionedTrialQueue`` three ways — directly, via
+    ``RemoteQueue`` over a loopback HTTP coordinator, and via a
+    coordinator that WALs + fsyncs every mutation (the multi-host
+    production config). No model involved: this bounds the per-lease
+    control-plane tax a host pays for fabric coordination, which is only
+    acceptable because leases batch ``lease_size`` trials — the reported
+    ``rpc_us_per_trial`` is what perf_gate should watch, not per-op
+    latency. Runs device-free, so it sits outside the HBM gate.
+    """
+    import tempfile as _tempfile
+    import time as _time
+    from pathlib import Path
+
+    from introspective_awareness_tpu.fabric import (
+        CoordinatorServer,
+        CoordinatorService,
+        PartitionedTrialQueue,
+        RemoteQueue,
+        RpcClient,
+    )
+    from introspective_awareness_tpu.obs.registry import MetricsRegistry
+
+    def drain_local() -> tuple[int, float]:
+        q = PartitionedTrialQueue(n_trials, 1, lease_size=lease_size)
+        ops = 0
+        t0 = _time.perf_counter()
+        while True:
+            lease = q.acquire(0)
+            if lease is None:
+                break
+            q.complete(lease)
+            ops += 2
+        return ops, _time.perf_counter() - t0
+
+    def drain_remote(wal_path=None) -> tuple[int, float]:
+        service = CoordinatorService(wal_path=wal_path, lease_ttl_s=None)
+        server = CoordinatorServer(service, port=0).start()
+        try:
+            client = RpcClient(server.url, registry=MetricsRegistry(),
+                               client_id="bench")
+            client.call("open_pass", {
+                "pass_id": "bench", "n_items": n_trials,
+                "n_workers": 1, "lease_size": lease_size,
+            })
+            rq = RemoteQueue(client, "bench")
+            ops = 0
+            t0 = _time.perf_counter()
+            while True:
+                lease = rq.acquire(0)
+                if lease is None:
+                    break
+                rq.complete(lease)
+                ops += 2
+            return ops, _time.perf_counter() - t0
+        finally:
+            server.stop()
+
+    drain_local()  # warm allocator/code paths out of the timed region
+    local_ops, local_t = drain_local()
+    rpc_ops, rpc_t = drain_remote()
+    with _tempfile.TemporaryDirectory(prefix="bench_coord_wal_") as td:
+        wal_ops, wal_t = drain_remote(Path(td) / "wal.jsonl")
+
+    def _rate(ops, t):
+        return round(ops / t, 1) if t > 0 else None
+
+    r = {
+        "n_trials": n_trials,
+        "lease_size": lease_size,
+        "local_ops_per_s": _rate(local_ops, local_t),
+        "rpc_ops_per_s": _rate(rpc_ops, rpc_t),
+        "rpc_wal_ops_per_s": _rate(wal_ops, wal_t),
+        "rpc_round_trip_us": (round(1e6 * rpc_t / rpc_ops, 1)
+                              if rpc_ops else None),
+        "rpc_wal_round_trip_us": (round(1e6 * wal_t / wal_ops, 1)
+                                  if wal_ops else None),
+        "rpc_us_per_trial": (round(1e6 * wal_t / n_trials, 1)
+                             if n_trials else None),
+    }
+    log(
+        f"  [coordinator_rpc] {n_trials} trials / lease {lease_size}: "
+        f"local {r['local_ops_per_s']} ops/s, rpc {r['rpc_ops_per_s']} "
+        f"ops/s, rpc+wal {r['rpc_wal_ops_per_s']} ops/s "
+        f"({r['rpc_us_per_trial']}us/trial amortized)"
+    )
+    return r
+
+
 def _hbm_model(runner, cfg, batch, prompt_len, max_new,
                batch_chunk=None, suffix_chunk=None) -> dict:
     """Modeled HBM bytes for the best config, chunk-plan aware.
@@ -1338,6 +1429,14 @@ def main() -> None:
         ledger,
     )
 
+    # ---- multi-host control plane: local vs RPC vs RPC+WAL queue drain -----
+    try:
+        coord = _coordinator_rpc_bench()
+    except Exception as e:  # noqa: BLE001 — control-plane-only, never fatal
+        log(f"  [coordinator_rpc] failed: {e}")
+        coord = {"skipped": True, "section": "coordinator_rpc",
+                 "reason": str(e)}
+
     # ---- chunked large-batch prefill: equivalence + AOT memory + autotune --
     pmem = _gated(
         "prefill_memory",
@@ -1609,6 +1708,7 @@ def main() -> None:
         "staged_prefill": stg,
         "durability": dur,
         "fabric": fab,
+        "coordinator_rpc": coord,
         "prefill_memory": pmem,
         "trace": trace_block,
         "backend": backend,

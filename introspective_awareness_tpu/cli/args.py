@@ -150,6 +150,37 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Trials per work-stealing lease (fabric queue "
                              "granularity). 0 = auto: one slot-batch "
                              "(--batch-size) per lease.")
+    parser.add_argument("--fabric-coordinator", type=str, default=None,
+                        help="Multi-host fabric: URL of the sweep "
+                             "coordinator (python -m introspective_"
+                             "awareness_tpu.fabric.coordinator). Every host "
+                             "runs the same sweep command against the same "
+                             "shared --output-dir; the coordinator leases "
+                             "queue positions across hosts, heartbeat TTLs "
+                             "requeue a preempted host's work, and per-host "
+                             "journals ship to the shared dir so results "
+                             "(and any resume) merge bit-identically.")
+    parser.add_argument("--fabric-host", type=int, default=0,
+                        help="This host's id (0-based) in the multi-host "
+                             "fabric; on TPU pods defaults should follow "
+                             "jax.process_index().")
+    parser.add_argument("--fabric-hosts", type=int, default=1,
+                        help="Total hosts in the multi-host fabric (the "
+                             "global worker space is hosts x replicas).")
+    parser.add_argument("--fabric-heartbeat", type=float, default=2.0,
+                        help="Seconds between coordinator heartbeats (each "
+                             "beat also ships journal snapshots to the "
+                             "shared dir).")
+    parser.add_argument("--fabric-spool", type=str, default=None,
+                        help="Local (host-private) spool dir for this "
+                             "host's journals before shipping; default: a "
+                             "temp dir. Point it at preemptible scratch.")
+    parser.add_argument("--jax-coordinator", type=str, default=None,
+                        help="Run jax.distributed.initialize against this "
+                             "coordinator address (host:port) before mesh "
+                             "setup — the real multi-process TPU pod path. "
+                             "CI emulates multi-host with separate "
+                             "single-process CPU hosts instead.")
     parser.add_argument("--judge-backend", type=str, default="openai",
                         choices=["openai", "on-device", "none"],
                         help="openai = API judge (reference behavior); "
@@ -230,7 +261,9 @@ def build_parser() -> argparse.ArgumentParser:
                              "judge_timeout=2,torn_tail'. Knobs: "
                              "crash_after_chunks, crash_on_admission, "
                              "judge_timeout, judge_rate_limit, judge_5xx, "
-                             "torn_tail. Never set in production runs.")
+                             "torn_tail, kill_replica, kill_host, "
+                             "kill_coordinator_after. Never set in "
+                             "production runs.")
     return parser
 
 

@@ -200,7 +200,8 @@ def _build_fabric(args, model_name: str, runner, mesh, rules):
     are keyed by global queue index, never by placement.
     """
     n = int(getattr(args, "fabric_replicas", 1) or 1)
-    if n <= 1:
+    coordinator = getattr(args, "fabric_coordinator", None)
+    if n <= 1 and not coordinator:
         return None
     import jax
 
@@ -234,10 +235,17 @@ def _build_fabric(args, model_name: str, runner, mesh, rules):
         ledger=getattr(args, "_ledger", None),
         journals=journal if isinstance(journal, FabricJournalSet) else None,
         progress=getattr(args, "_progress", None),
+        coordinator_url=coordinator,
+        host_id=int(getattr(args, "fabric_host", 0) or 0),
+        n_hosts=int(getattr(args, "fabric_hosts", 1) or 1),
+        heartbeat_s=float(getattr(args, "fabric_heartbeat", 2.0) or 2.0),
+        metrics_url=getattr(args, "_metrics_url", None),
     )
     print(
         f"  fabric: {n} replicas x {per} device(s) each "
         f"({'disjoint sub-meshes' if disjoint else 'shared mesh'})"
+        + (f", host {fabric.host_id}/{fabric.n_hosts} via {coordinator}"
+           if coordinator else "")
     )
     return fabric
 
@@ -284,6 +292,40 @@ def _open_journal(args, model_name: str):
     from introspective_awareness_tpu.fabric import FabricJournalSet
 
     n_fabric = int(getattr(args, "fabric_replicas", 1) or 1)
+    if getattr(args, "fabric_coordinator", None):
+        # Multi-host: this host journals into a local spool and ships
+        # snapshots to the shared output dir; other hosts' shipped files
+        # merge in read-only. Overwrite touches only OUR files — the
+        # other hosts own (and may be actively shipping) theirs.
+        import tempfile
+
+        host = int(getattr(args, "fabric_host", 0) or 0)
+        spool = getattr(args, "fabric_spool", None) or tempfile.mkdtemp(
+            prefix=f"iat_spool_host{host}_"
+        )
+        t0 = time.perf_counter()
+        if args.overwrite:
+            for k in range(n_fabric):
+                name = FabricJournalSet.host_replica_name(path, host, k)
+                for p in (path.parent / name, Path(spool) / name):
+                    if p.exists():
+                        p.unlink()
+        journal = FabricJournalSet(
+            path, _journal_config(args, model_name), n_replicas=n_fabric,
+            host_id=host, spool_dir=spool,
+        )
+        if journal.resumed:
+            journal.compact()
+            g = journal.gauges
+            print(
+                f"  resuming from shipped trial journals: "
+                f"{g.recovered_trials} trials recovered "
+                f"({g.recovered_grades} graded, "
+                f"{g.torn_records_dropped} torn records dropped)"
+            )
+        journal.gauges.resume_wall_s = round(time.perf_counter() - t0, 4)
+        return journal
+
     replica_files = FabricJournalSet.discover(path)
     if args.overwrite:
         for p in (path, *replica_files):
@@ -685,6 +727,14 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 f"deferred grading; journal kept — rerun when the judge "
                 f"recovers"
             )
+        elif getattr(journal, "multihost", False):
+            # Keep (not discard) shipped journals in multi-host mode:
+            # another host may still be filling its final pass from our
+            # records. Every host keeps them; a later identical run
+            # replays fully-complete state and fast-paths past it.
+            journal.flush()
+            journal.close()
+            args._journal = None
         else:
             # Every trial is persisted in final artifacts; the journal has
             # nothing left to recover.
@@ -990,6 +1040,20 @@ def main(argv: Optional[list[str]] = None) -> int:
             "per-trial granularity to partition or steal)"
         )
         return 2
+    if getattr(args, "fabric_coordinator", None):
+        if args.scheduler != "continuous":
+            print(
+                "error: --fabric-coordinator requires --scheduler "
+                "continuous (the coordinator leases per-trial work)"
+            )
+            return 2
+        if args.journal == "off":
+            print(
+                "error: --fabric-coordinator requires the trial journal "
+                "(remote hosts' results travel through shipped journals); "
+                "drop --journal off"
+            )
+            return 2
 
     # Fault injection (test/CI harness only): --inject-faults wins over the
     # IAT_FAULTS env var; both absent → None (zero overhead on hot paths).
@@ -1059,6 +1123,17 @@ def main(argv: Optional[list[str]] = None) -> int:
         args.pp = 1
     import jax
 
+    if getattr(args, "jax_coordinator", None):
+        # Real multi-process pod path: one jax process per host, meshes
+        # built from jax.local_devices(). CI instead emulates multi-host
+        # with independent single-process CPU hosts (no cross-host
+        # collectives are needed — the fabric shards TRIALS, not arrays).
+        jax.distributed.initialize(
+            coordinator_address=args.jax_coordinator,
+            num_processes=int(getattr(args, "fabric_hosts", 1) or 1),
+            process_id=int(getattr(args, "fabric_host", 0) or 0),
+        )
+
     devices = (
         jax.devices()[:args.n_devices] if args.n_devices else None
     )
@@ -1107,6 +1182,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     from introspective_awareness_tpu.obs import (
         AggregateProgress,
         ChunkTrace,
+        HealthState,
         MetricsServer,
     )
 
@@ -1126,11 +1202,47 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args._judge_breaker is not None:
         breaker = args._judge_breaker
         progress.add_probe("judge_breaker", lambda: breaker.state)
+    # Degradation probes behind /healthz: an open judge breaker, a journal
+    # that can no longer fsync, or a dead fabric worker flip the endpoint
+    # to 503 with the reason — what a pod supervisor keys restarts off.
+    health = HealthState()
+    if args._judge_breaker is not None:
+        jb = args._judge_breaker
+        health.add_probe(
+            "judge_breaker",
+            lambda: ("circuit breaker open — grading degraded"
+                     if jb.state == "open" else None),
+        )
+    health.add_probe(
+        "journal_fsync",
+        lambda: ("journal fsync failing — durability degraded"
+                 if getattr(getattr(args, "_journal", None),
+                            "fsync_failed", False) else None),
+    )
+    health.add_probe(
+        "fabric_workers",
+        lambda: next(
+            (f"replica {w.replica_id} died: "
+             f"{type(w.error).__name__}: {w.error}"
+             for w in getattr(getattr(args, "_fabric", None),
+                              "workers", [])
+             if w.error is not None and not w.interrupted),
+            None,
+        ),
+    )
     metrics_server = None
-    if args.metrics_port is not None:
+    # Multi-host federation needs every host scrapeable: the coordinator
+    # pulls each host's /registry and /progress, so coordinator mode
+    # auto-starts the server on an ephemeral port when none was asked for.
+    metrics_port = args.metrics_port
+    if metrics_port is None and getattr(args, "fabric_coordinator", None):
+        metrics_port = 0
+    args._metrics_url = None
+    if metrics_port is not None:
         metrics_server = MetricsServer(
-            progress=progress, port=args.metrics_port
+            progress=progress, port=metrics_port, health=health
         ).start()
+        args._metrics_url = metrics_server.url
         print(
             f"metrics: {metrics_server.url}/metrics  "
             f"progress: {metrics_server.url}/progress"
@@ -1206,6 +1318,11 @@ def _run_models(args, models, judge, ledger, mesh, rules) -> int:
                     journal.compact()
                     journal.flush()
                     journal.close()
+                elif getattr(journal, "multihost", False):
+                    # Other hosts may still be mid-sweep against our
+                    # shipped records — never delete shared state here.
+                    journal.flush()
+                    journal.close()
                 else:
                     journal.discard()
                 args._journal = None
@@ -1221,7 +1338,8 @@ def _run_models(args, models, judge, ledger, mesh, rules) -> int:
             runner.prefill_suffix_chunk = getattr(
                 args, "prefill_suffix_chunk", None)
             args._fabric = None
-            if getattr(args, "fabric_replicas", 1) > 1:
+            if (getattr(args, "fabric_replicas", 1) > 1
+                    or getattr(args, "fabric_coordinator", None)):
                 with ledger.span("load", model=model_name, what="fabric_replicas"):
                     args._fabric = _build_fabric(
                         args, model_name, runner, mesh, rules

@@ -1,23 +1,39 @@
-"""Sweep fabric: multi-replica data-parallel sweep execution.
+"""Sweep fabric: multi-replica / multi-host data-parallel sweep execution.
 
 N model replicas — each a ModelRunner + continuous slot scheduler over
 its own device subset — drain one partitioned global trial queue with
 lease-based work stealing, while per-replica trial journals merge into a
-single bit-identical, resumable result set. See ``fabric.py`` for the
-determinism argument and README "Sweep fabric" for the operator view.
+single bit-identical, resumable result set. In multi-host mode the queue
+is served by a fault-tolerant RPC coordinator (WAL-backed, heartbeat
+lease TTLs, idempotent retries) and per-host journals ship to shared
+storage for the merged resume. See ``fabric.py`` for the determinism
+argument, ``coordinator.py`` for the failure plane, and README "Sweep
+fabric" for the operator view.
 """
 
+from .coordinator import (
+    CoordinatorServer,
+    CoordinatorService,
+    RemoteQueue,
+)
 from .fabric import SweepFabric
 from .journal import FabricJournalSet
 from .queue import PartitionedTrialQueue, QueueStats, WorkLease
+from .transport import CoordinatorUnavailable, RpcClient, RpcFault
 from .worker import ReplicaStats, ReplicaWorker
 
 __all__ = [
+    "CoordinatorServer",
+    "CoordinatorService",
+    "CoordinatorUnavailable",
     "FabricJournalSet",
     "PartitionedTrialQueue",
     "QueueStats",
+    "RemoteQueue",
     "ReplicaStats",
     "ReplicaWorker",
+    "RpcClient",
+    "RpcFault",
     "SweepFabric",
     "WorkLease",
 ]

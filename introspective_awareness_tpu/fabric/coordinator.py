@@ -1,0 +1,641 @@
+"""Multi-host trial-queue coordinator: the fabric's RPC control plane.
+
+One coordinator per pod slice serves the :class:`PartitionedTrialQueue`
+over the stdlib JSON transport in :mod:`.transport`, preserving the
+exact acquire/complete/fail/steal semantics of the in-process queue —
+worker hosts see the same lease protocol whether the queue lives in
+their process or across the network. What the RPC layer adds is the
+failure plane:
+
+- **Lease TTL via heartbeats** — every host heartbeats; a host that
+  stops (preempted, wedged) has its outstanding leases requeued to the
+  front of their home partitions by the queue's TTL expiry, so
+  survivors pick the work up in queue order. Expired indices keep their
+  global queue position (the PRNG stream id), so recovery is
+  bit-identical.
+- **Idempotent RPCs** — every mutating call carries a client-minted
+  ``req_id``; the coordinator caches responses, so a retry after a lost
+  response replays the SAME lease instead of double-issuing.
+- **Crash recovery** — every mutation is appended to a CRC-framed WAL
+  (the :mod:`runtime.journal` framing) and fsynced before the response
+  goes out. A restarted coordinator replays the WAL, restores
+  partitions, outstanding leases (fresh TTL), the idempotency cache and
+  lease-id counter — no trial is lost or double-executed across the
+  restart.
+- **Federated telemetry** — hosts register their metrics URL; the
+  coordinator's ``/metrics`` and ``/progress`` pull each host's
+  ``/registry``/``/progress`` and serve the fleet view (per-host series
+  re-labeled ``host="<h>"``), with last-good caching when a host scrape
+  fails.
+
+``RemoteQueue`` is the worker-host facade: it speaks this protocol but
+exposes the in-process queue surface (``acquire``/``complete``/``fail``
+/``stats``), so :class:`~.worker.ReplicaWorker` drains it unchanged.
+Unlike the local queue, its ``acquire`` BLOCKS while other hosts still
+hold leases — TTL expiry can requeue their work — and returns ``None``
+only once the pass is globally complete.
+
+Standalone serving: ``python -m introspective_awareness_tpu.fabric.coordinator
+--port 0 --port-file p.txt --wal coordinator_wal.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from collections import OrderedDict
+from pathlib import Path
+from typing import Callable, Optional
+
+from introspective_awareness_tpu.obs.http import PROM_CONTENT_TYPE
+from introspective_awareness_tpu.obs.registry import render_federated
+from introspective_awareness_tpu.runtime.journal import (
+    JournalError,
+    SweepInterrupted,
+    _frame,
+    _parse_line,
+)
+
+from .queue import PartitionedTrialQueue, QueueStats, WorkLease
+from .transport import (
+    CoordinatorUnavailable,
+    RpcClient,
+    RpcFault,
+    RpcTransportServer,
+)
+
+WAL_SCHEMA = 1
+_IDEMPOTENCY_CACHE_MAX = 8192
+
+
+def _lease_doc(lease: WorkLease) -> dict:
+    return {"lease_id": lease.lease_id, "replica": lease.replica,
+            "home": lease.home, "indices": list(lease.indices),
+            "stolen": lease.stolen}
+
+
+class _Pass:
+    """One scheduler pass: a queue plus the coordinator's lease table."""
+
+    def __init__(self, pass_id: str, n_items: int, n_workers: int,
+                 lease_size: int, queue: PartitionedTrialQueue) -> None:
+        self.pass_id = pass_id
+        self.n_items = int(n_items)
+        self.n_workers = int(n_workers)
+        self.lease_size = int(lease_size)
+        self.queue = queue
+        # lease_id -> lease, for complete/fail by id and expiry diffing.
+        self.leases: dict[int, WorkLease] = {}
+
+
+class CoordinatorService:
+    """The dispatchable queue service: state, WAL, idempotency cache.
+
+    Transport-agnostic — ``handle(method, params, req_id)`` is wired
+    into :class:`~.transport.RpcTransportServer` by
+    :class:`CoordinatorServer` and called directly by unit tests.
+    """
+
+    def __init__(
+        self,
+        wal_path: Optional[Path | str] = None,
+        lease_ttl_s: Optional[float] = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.lease_ttl_s = lease_ttl_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._passes: dict[str, _Pass] = {}
+        self._responses: "OrderedDict[str, dict]" = OrderedDict()
+        # host id -> {"metrics_url", "last_seen", snapshots...}
+        self.hosts: dict[str, dict] = {}
+        self._wal_path = None if wal_path is None else Path(wal_path)
+        self._wal = None
+        if self._wal_path is not None:
+            self._wal_path.parent.mkdir(parents=True, exist_ok=True)
+            if self._wal_path.exists() and self._wal_path.stat().st_size:
+                self._recover()
+            else:
+                self._wal = open(self._wal_path, "wb")
+                self._wal_append({"ev": "coord_start",
+                                  "schema": WAL_SCHEMA})
+
+    # -- WAL ------------------------------------------------------------------
+
+    def _wal_append(self, obj: dict) -> None:
+        if self._wal is None:
+            return
+        self._wal.write(_frame(obj))
+        self._wal.flush()
+        # Coordinator ops are per-lease, not per-token: fsync every record
+        # so a response is never observable before its WAL entry is
+        # durable (the no-double-issue guarantee across restarts).
+        os.fsync(self._wal.fileno())
+
+    def _recover(self) -> None:
+        """Replay the WAL: rebuild every pass's partitions, outstanding
+        leases (fresh TTLs), lease-id counters and the idempotency cache.
+        Torn final record is dropped (the response for it never went out,
+        so the client will retry with the same req_id); corruption before
+        the tail raises."""
+        raw = self._wal_path.read_bytes()
+        lines = raw.splitlines(keepends=True)
+        records: list[dict] = []
+        valid_bytes = 0
+        bad_at: Optional[int] = None
+        for i, ln in enumerate(lines):
+            rec = _parse_line(ln)
+            if rec is None:
+                if bad_at is None:
+                    bad_at = i
+                continue
+            if bad_at is not None:
+                raise JournalError(
+                    f"{self._wal_path}: corrupt WAL record at line "
+                    f"{bad_at + 1} followed by valid records — damaged "
+                    f"beyond torn-tail recovery"
+                )
+            records.append(rec)
+            valid_bytes += len(ln)
+        if records and records[0].get("ev") != "coord_start":
+            raise JournalError(
+                f"{self._wal_path}: first record is not 'coord_start' — "
+                f"not a coordinator WAL"
+            )
+        # Replay pass state with plain lists, then freeze into queues.
+        state: dict[str, dict] = {}
+        for rec in records[1:]:
+            ev = rec.get("ev")
+            if ev == "pass_open":
+                pid = rec["pass"]
+                q0 = PartitionedTrialQueue(
+                    rec["n_items"], rec["n_workers"], rec["lease_size"]
+                )
+                state[pid] = {
+                    "n_items": rec["n_items"],
+                    "n_workers": rec["n_workers"],
+                    "lease_size": rec["lease_size"],
+                    "parts": [list(p) for p in q0._parts],
+                    "leases": {},
+                    "next_lease": 0,
+                    "stats": QueueStats(),
+                }
+                continue
+            st = state.get(rec.get("pass"))
+            if st is None:
+                continue
+            if ev == "acquire":
+                d = rec["lease"]
+                lease = WorkLease(d["lease_id"], d["replica"], d["home"],
+                                  list(d["indices"]), d["stolen"])
+                for i in lease.indices:
+                    st["parts"][lease.home].remove(i)
+                st["leases"][lease.lease_id] = lease
+                st["next_lease"] = max(st["next_lease"],
+                                       lease.lease_id + 1)
+                st["stats"].leases += 1
+                if lease.stolen:
+                    st["stats"].steals += 1
+                    st["stats"].stolen_trials += len(lease.indices)
+                if rec.get("req"):
+                    self._cache(rec["req"],
+                                {"lease": _lease_doc(lease), "done": False})
+            elif ev == "complete":
+                lease = st["leases"].pop(rec["lease_id"], None)
+                if lease is not None:
+                    st["stats"].completed_trials += len(lease.indices)
+                if rec.get("req"):
+                    self._cache(rec["req"], {"completed": True})
+            elif ev in ("fail", "expire"):
+                lease = st["leases"].pop(rec["lease_id"], None)
+                if lease is not None:
+                    st["parts"][lease.home][:0] = lease.indices
+                    if ev == "fail":
+                        st["stats"].failed_leases += 1
+                    else:
+                        st["stats"].expired_leases += 1
+                if rec.get("req"):
+                    self._cache(rec["req"], {"failed": True})
+        for pid, st in state.items():
+            q = PartitionedTrialQueue.restore(
+                st["n_items"], st["n_workers"], st["lease_size"],
+                st["parts"], list(st["leases"].values()),
+                st["next_lease"], lease_ttl_s=self.lease_ttl_s,
+                clock=self._clock, stats=st["stats"],
+            )
+            p = _Pass(pid, st["n_items"], st["n_workers"],
+                      st["lease_size"], q)
+            p.leases = dict(st["leases"])
+            self._passes[pid] = p
+        # Reopen for append, truncated back to the valid prefix.
+        self._wal = open(self._wal_path, "r+b")
+        self._wal.truncate(valid_bytes)
+        self._wal.seek(0, os.SEEK_END)
+        if not records:
+            self._wal_append({"ev": "coord_start", "schema": WAL_SCHEMA})
+
+    # -- idempotency ----------------------------------------------------------
+
+    def _cache(self, req_id: str, result: dict) -> None:
+        self._responses[req_id] = result
+        while len(self._responses) > _IDEMPOTENCY_CACHE_MAX:
+            self._responses.popitem(last=False)
+
+    # -- dispatch -------------------------------------------------------------
+
+    def handle(self, method: str, params: dict,
+               req_id: Optional[str] = None) -> dict:
+        fn = getattr(self, f"_rpc_{method}", None)
+        if fn is None:
+            raise RpcFault(f"unknown method {method!r}")
+        with self._lock:
+            if req_id is not None and req_id in self._responses:
+                return self._responses[req_id]
+            return fn(params, req_id)
+
+    def _pass(self, params: dict) -> _Pass:
+        pid = params.get("pass_id")
+        p = self._passes.get(pid)
+        if p is None:
+            raise RpcFault(f"unknown pass {pid!r} — open_pass first")
+        return p
+
+    def _reconcile_expired(self, p: _Pass) -> None:
+        """WAL any lease the queue's TTL machinery requeued since the
+        last call, and drop it from the coordinator's lease table."""
+        live = p.queue.outstanding_ids()
+        for lease_id in [i for i in p.leases if i not in live]:
+            del p.leases[lease_id]
+            self._wal_append({"ev": "expire", "pass": p.pass_id,
+                              "lease_id": lease_id})
+
+    def _rpc_ping(self, params: dict, req_id) -> dict:
+        return {"time": time.time()}
+
+    def _rpc_open_pass(self, params: dict, req_id) -> dict:
+        """Create-or-join: every host computes the same task list, so the
+        first arrival creates the pass and later ones just validate that
+        their view of the grid matches."""
+        pid = str(params["pass_id"])
+        n_items = int(params["n_items"])
+        n_workers = int(params["n_workers"])
+        lease_size = max(1, int(params.get("lease_size", 1)))
+        p = self._passes.get(pid)
+        if p is not None:
+            if (p.n_items, p.n_workers) != (n_items, n_workers):
+                raise RpcFault(
+                    f"pass {pid!r} already open with n_items={p.n_items} "
+                    f"n_workers={p.n_workers}, host sent n_items={n_items} "
+                    f"n_workers={n_workers} — grid configs diverge"
+                )
+            return {"created": False}
+        queue = PartitionedTrialQueue(
+            n_items, n_workers, lease_size,
+            lease_ttl_s=self.lease_ttl_s, clock=self._clock,
+        )
+        self._passes[pid] = _Pass(pid, n_items, n_workers, lease_size,
+                                  queue)
+        self._wal_append({"ev": "pass_open", "pass": pid,
+                          "n_items": n_items, "n_workers": n_workers,
+                          "lease_size": lease_size})
+        return {"created": True}
+
+    def _rpc_acquire(self, params: dict, req_id) -> dict:
+        p = self._pass(params)
+        self._reconcile_expired(p)
+        lease = p.queue.acquire(int(params["worker"]))
+        if lease is None:
+            done = (p.queue.remaining() == 0
+                    and p.queue.outstanding() == 0)
+            # Not cached/WAL'd: a null acquire has no side effect, and
+            # the polling client re-asks with a fresh req_id anyway.
+            return {"lease": None, "done": done}
+        p.leases[lease.lease_id] = lease
+        self._wal_append({"ev": "acquire", "pass": p.pass_id,
+                          "req": req_id, "lease": _lease_doc(lease)})
+        result = {"lease": _lease_doc(lease), "done": False}
+        if req_id is not None:
+            self._cache(req_id, result)
+        return result
+
+    def _rpc_complete(self, params: dict, req_id) -> dict:
+        p = self._pass(params)
+        self._reconcile_expired(p)
+        lease = p.leases.pop(int(params["lease_id"]), None)
+        if lease is not None:
+            p.queue.complete(lease)
+        # Idempotent either way: a duplicate complete (retried RPC, or a
+        # stale holder racing TTL expiry) is a recorded no-op.
+        self._wal_append({"ev": "complete", "pass": p.pass_id,
+                          "req": req_id,
+                          "lease_id": int(params["lease_id"])})
+        result = {"completed": lease is not None}
+        if req_id is not None:
+            self._cache(req_id, result)
+        return result
+
+    def _rpc_fail(self, params: dict, req_id) -> dict:
+        p = self._pass(params)
+        self._reconcile_expired(p)
+        lease = p.leases.pop(int(params["lease_id"]), None)
+        if lease is not None:
+            p.queue.fail(lease)
+        self._wal_append({"ev": "fail", "pass": p.pass_id,
+                          "req": req_id,
+                          "lease_id": int(params["lease_id"])})
+        result = {"failed": lease is not None}
+        if req_id is not None:
+            self._cache(req_id, result)
+        return result
+
+    def _rpc_heartbeat(self, params: dict, req_id) -> dict:
+        """Renew TTLs on every lease held by the host's workers and
+        refresh its liveness/telemetry registration."""
+        host = str(params.get("host", ""))
+        workers = [int(w) for w in params.get("workers") or []]
+        renewed = 0
+        for p in self._passes.values():
+            for w in workers:
+                renewed += p.queue.touch(w)
+        ent = self.hosts.setdefault(host, {})
+        ent["last_seen"] = time.time()
+        if params.get("metrics_url"):
+            ent["metrics_url"] = str(params["metrics_url"])
+        return {"renewed": renewed}
+
+    def _rpc_register_host(self, params: dict, req_id) -> dict:
+        host = str(params["host"])
+        ent = self.hosts.setdefault(host, {})
+        ent["metrics_url"] = str(params.get("metrics_url") or "")
+        ent["last_seen"] = time.time()
+        return {"hosts": sorted(self.hosts)}
+
+    def _rpc_status(self, params: dict, req_id) -> dict:
+        p = self._pass(params)
+        self._reconcile_expired(p)
+        remaining = p.queue.remaining()
+        outstanding = p.queue.outstanding()
+        return {
+            "remaining": remaining,
+            "outstanding": outstanding,
+            "done": remaining == 0 and outstanding == 0,
+            "stats": p.queue.stats.as_stats(),
+        }
+
+    # -- federation (GET-route helpers, called off-lock) ----------------------
+
+    def _pull_host(self, host: str, path: str) -> Optional[dict]:
+        ent = self.hosts.get(host) or {}
+        url = ent.get("metrics_url")
+        if not url:
+            return ent.get(f"cached{path}")
+        try:
+            with urllib.request.urlopen(url + path, timeout=2.0) as r:
+                doc = json.loads(r.read().decode("utf-8"))
+            ent[f"cached{path}"] = doc
+            return doc
+        except Exception:  # noqa: BLE001 — serve last-good, mark stale
+            return ent.get(f"cached{path}")
+
+    def federated_metrics(self) -> str:
+        snaps = {}
+        for host in sorted(self.hosts):
+            doc = self._pull_host(host, "/registry")
+            if doc is not None:
+                snaps[host] = doc
+        return render_federated(snaps)
+
+    def federated_progress(self) -> dict:
+        hosts: dict[str, dict] = {}
+        done = total = 0
+        rate = 0.0
+        for host in sorted(self.hosts):
+            doc = self._pull_host(host, "/progress")
+            if doc is None:
+                hosts[host] = {"unreachable": True}
+                continue
+            hosts[host] = doc
+            done += int(doc.get("trials_done") or 0)
+            total += int(doc.get("trials_total") or 0)
+            rate += float(doc.get("evals_per_s") or 0.0)
+        out = {
+            "trials_done": done,
+            "trials_total": total,
+            "evals_per_s": round(rate, 4),
+            "eta_s": (round((total - done) / rate, 1)
+                      if rate > 0 and total > done else None),
+            "unix_time": time.time(),
+            "hosts": hosts,
+            "passes": {},
+        }
+        with self._lock:
+            for pid, p in self._passes.items():
+                out["passes"][pid] = {
+                    "remaining": p.queue.remaining(),
+                    "outstanding": p.queue.outstanding(),
+                    "stats": p.queue.stats.as_stats(),
+                }
+        return out
+
+    def close(self) -> None:
+        if self._wal is not None and not self._wal.closed:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+            self._wal.close()
+
+
+class CoordinatorServer:
+    """HTTP front for :class:`CoordinatorService`: ``POST /rpc`` plus
+    federated ``GET /metrics`` / ``/progress`` / ``/healthz``. An
+    optional ``faults`` plan is ticked per request so
+    ``kill_coordinator_after=N`` can crash the process mid-protocol."""
+
+    def __init__(self, service: CoordinatorService,
+                 host: str = "127.0.0.1", port: int = 0,
+                 faults=None) -> None:
+        self.service = service
+        self._faults = faults
+
+        def _healthz() -> tuple[int, str, bytes]:
+            return 200, "text/plain", b"ok\n"
+
+        def _metrics() -> tuple[int, str, bytes]:
+            return (200, PROM_CONTENT_TYPE,
+                    service.federated_metrics().encode())
+
+        def _progress() -> tuple[int, str, bytes]:
+            return (200, "application/json",
+                    json.dumps(service.federated_progress()).encode())
+
+        self._server = RpcTransportServer(
+            service.handle,
+            get_routes={"/healthz": _healthz, "/metrics": _metrics,
+                        "/progress": _progress},
+            host=host, port=port, on_request=self._tick,
+        )
+
+    def _tick(self) -> None:
+        if self._faults is not None:
+            try:
+                self._faults.tick("rpc")
+            except BaseException:
+                # A coordinator "kill" must be a hard death — no WAL
+                # flush beyond what each op already fsynced, no goodbye.
+                os._exit(41)
+
+    @property
+    def url(self) -> str:
+        return self._server.url
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.port
+
+    def start(self) -> "CoordinatorServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop()
+        self.service.close()
+
+
+class RemoteQueue:
+    """Worker-host facade: the in-process queue surface over RPC.
+
+    ``acquire`` polls while other hosts hold outstanding leases (their
+    TTL expiry can hand this host more work) and returns ``None`` only
+    when the pass is globally complete — so a ``ReplicaWorker`` joining
+    means the whole FLEET finished the pass, not just this host.
+    ``complete`` runs ``before_complete`` (the fabric ships journals
+    there) BEFORE the RPC, so a lease is never globally complete until
+    its results are durable on shared storage. A
+    :class:`CoordinatorUnavailable` from the client's circuit breaker
+    surfaces as ``SweepInterrupted``: the host drains and exits
+    gracefully (journals flushed/shipped) instead of crashing the fleet.
+    """
+
+    def __init__(
+        self,
+        client: RpcClient,
+        pass_id: str,
+        worker_base: int = 0,
+        poll_interval_s: float = 0.2,
+        before_complete: Optional[Callable[[WorkLease], None]] = None,
+        abort: Optional[threading.Event] = None,
+    ) -> None:
+        self._client = client
+        self.pass_id = pass_id
+        self.worker_base = int(worker_base)
+        self.poll_interval_s = poll_interval_s
+        self._before_complete = before_complete
+        self._abort = abort
+        self.stats = QueueStats()
+        self._stats_lock = threading.Lock()
+
+    def _worker(self, replica: int) -> int:
+        return self.worker_base + int(replica)
+
+    def _call(self, method: str, params: dict) -> dict:
+        try:
+            return self._client.call(method, params)
+        except CoordinatorUnavailable as e:
+            raise SweepInterrupted(
+                f"coordinator unreachable — draining host: {e}"
+            ) from e
+
+    def acquire(self, replica: int) -> Optional[WorkLease]:
+        while True:
+            doc = self._call("acquire", {
+                "pass_id": self.pass_id, "worker": self._worker(replica),
+            })
+            d = doc.get("lease")
+            if d is not None:
+                lease = WorkLease(d["lease_id"], int(replica), d["home"],
+                                  list(d["indices"]), d["stolen"])
+                with self._stats_lock:
+                    self.stats.leases += 1
+                    if lease.stolen:
+                        self.stats.steals += 1
+                        self.stats.stolen_trials += len(lease.indices)
+                return lease
+            if doc.get("done"):
+                return None
+            if self._abort is not None and self._abort.is_set():
+                return None
+            time.sleep(self.poll_interval_s)
+
+    def complete(self, lease: WorkLease) -> None:
+        if self._before_complete is not None:
+            self._before_complete(lease)
+        self._call("complete", {
+            "pass_id": self.pass_id, "lease_id": lease.lease_id,
+            "worker": self._worker(lease.replica),
+        })
+        with self._stats_lock:
+            self.stats.completed_trials += len(lease.indices)
+
+    def fail(self, lease: WorkLease) -> None:
+        self._call("fail", {
+            "pass_id": self.pass_id, "lease_id": lease.lease_id,
+            "worker": self._worker(lease.replica),
+        })
+        with self._stats_lock:
+            self.stats.failed_leases += 1
+
+    def status(self) -> dict:
+        return self._call("status", {"pass_id": self.pass_id})
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Sweep-fabric RPC coordinator (one per pod slice)."
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 = ephemeral; see --port-file")
+    ap.add_argument("--port-file", default=None,
+                    help="write the bound port here (atomic) once serving")
+    ap.add_argument("--wal", default=None,
+                    help="CRC-framed WAL path; restart with the same path "
+                         "to resume leases instead of double-issuing")
+    ap.add_argument("--lease-ttl", type=float, default=30.0,
+                    help="seconds without a heartbeat before a host's "
+                         "leases requeue (0 disables)")
+    args = ap.parse_args(argv)
+
+    faults = None
+    spec = os.environ.get("IAT_FAULTS")
+    if spec:
+        from introspective_awareness_tpu.runtime.faults import FaultPlan
+        faults = FaultPlan.from_spec(spec)
+
+    service = CoordinatorService(
+        wal_path=args.wal,
+        lease_ttl_s=args.lease_ttl if args.lease_ttl > 0 else None,
+    )
+    server = CoordinatorServer(service, host=args.host, port=args.port,
+                               faults=faults).start()
+    if args.port_file:
+        tmp = Path(args.port_file).with_suffix(".tmp")
+        tmp.write_text(str(server.port))
+        os.replace(tmp, args.port_file)
+    print(f"coordinator serving on {server.url}"
+          + (f" (wal: {args.wal})" if args.wal else ""), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

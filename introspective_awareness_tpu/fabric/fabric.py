@@ -26,19 +26,36 @@ flush journals). With a :class:`~.journal.FabricJournalSet` attached,
 each worker's thread binds its replica id so finalized trials land in
 that replica's journal file; merged replay makes kill-one-worker resume
 bit-identical as well.
+
+Multi-host mode (``coordinator_url`` given) swaps the in-process queue
+for a :class:`~.coordinator.RemoteQueue` against the pod-slice
+coordinator: every host opens the same pass (create-or-join, keyed by a
+hash of the pass's trial identities so two hosts — or a resumed run —
+can never join a pass from a different grid), drains leases for its
+local replicas under global worker ids ``host*R + k``, ships its
+journals to shared storage before each ``complete`` RPC, and heartbeats
+so a preempted host's leases TTL-requeue to survivors. Because leases
+are globally complete only when their records are durable on shared
+storage, a pass that drains lets every host fill the trials decoded
+remotely from the refreshed merged journals — the returned list is the
+full pass on every host, bit-identical across host counts for the same
+reason it is across replica counts.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import zlib
 from typing import Optional, Sequence
 
 from introspective_awareness_tpu.obs.registry import default_registry
 from introspective_awareness_tpu.runtime.journal import SweepInterrupted
 
+from .coordinator import RemoteQueue
 from .journal import FabricJournalSet
 from .queue import PartitionedTrialQueue
+from .transport import RpcClient
 from .worker import ReplicaWorker
 
 
@@ -50,6 +67,13 @@ class SweepFabric:
     ``partitions`` pins an explicit initial split of queue positions for
     every pass (tests use a fully-skewed split to force steals);
     production leaves it None for the contiguous even split.
+
+    Multi-host: ``coordinator_url`` points every host at the shared RPC
+    coordinator; ``host_id``/``n_hosts`` place this host's replicas in
+    the global worker-id space (``host_id*R .. host_id*R+R-1``) and the
+    queue is partitioned over ``n_hosts * R`` workers fleet-wide.
+    Requires ``journals`` in multi-host (shipping) mode — remote hosts'
+    results are only reachable through shared-storage journals.
     """
 
     def __init__(
@@ -62,6 +86,13 @@ class SweepFabric:
         progress=None,
         registry=None,
         partitions: Optional[Sequence[Sequence[int]]] = None,
+        coordinator_url: Optional[str] = None,
+        host_id: int = 0,
+        n_hosts: int = 1,
+        heartbeat_s: float = 2.0,
+        metrics_url: Optional[str] = None,
+        rpc_client: Optional[RpcClient] = None,
+        heartbeat_client: Optional[RpcClient] = None,
     ) -> None:
         if not runners:
             raise ValueError("fabric needs at least one runner")
@@ -73,6 +104,45 @@ class SweepFabric:
         self.partitions = partitions
         self.last_stats: dict = {}
         self._passes = 0
+
+        self.coordinator_url = coordinator_url
+        self.host_id = int(host_id)
+        self.n_hosts = max(1, int(n_hosts))
+        self.heartbeat_s = max(0.1, float(heartbeat_s))
+        self.metrics_url = metrics_url
+        self._client: Optional[RpcClient] = None
+        self._hb_client: Optional[RpcClient] = None
+        if coordinator_url is not None:
+            if partitions is not None:
+                raise ValueError(
+                    "explicit partitions are a single-host test affordance; "
+                    "multi-host partitioning is owned by the coordinator"
+                )
+            if journals is None or not getattr(journals, "multihost", False):
+                raise ValueError(
+                    "multi-host fabric requires a FabricJournalSet in "
+                    "shipping mode (host_id + spool_dir): remote results "
+                    "are only reachable through shared-storage journals"
+                )
+            self._client = rpc_client if rpc_client is not None else RpcClient(
+                coordinator_url, client_id=f"host{self.host_id}",
+                registry=registry,
+            )
+            # The heartbeat runs on its own low-retry client so transient
+            # coordinator blips neither stall the beat nor feed the main
+            # client's circuit breaker.
+            self._hb_client = (
+                heartbeat_client if heartbeat_client is not None
+                else RpcClient(
+                    coordinator_url, timeout_s=2.0, max_retries=1,
+                    backoff_base_s=0.1, breaker_threshold=1_000_000,
+                    client_id=f"host{self.host_id}-hb",
+                )
+            )
+            self._client.call("register_host", {
+                "host": str(self.host_id),
+                "metrics_url": self.metrics_url or "",
+            })
 
         reg = registry if registry is not None else default_registry()
         labels = [str(k) for k in range(len(self.workers))]
@@ -139,10 +209,17 @@ class SweepFabric:
         faults=None,
         trace=None,
         partitions: Optional[Sequence[Sequence[int]]] = None,
+        trial_keys: Optional[Sequence[str]] = None,
+        pass_name: Optional[str] = None,
     ) -> list[str]:
         """Drain one grid pass through all replicas. Same contract as the
         runner method; ``trial_ids`` are the GLOBAL stream ids (callers that
-        pass None get ``range(N)`` — the uninterrupted single-queue ids)."""
+        pass None get ``range(N)`` — the uninterrupted single-queue ids).
+
+        Multi-host additionally needs ``trial_keys`` (each position's
+        journal identity) and ``pass_name`` (the journal pass key): the
+        trials other hosts decode come back through the shipped journals,
+        keyed by (pass, trial id)."""
         N = len(prompts)
         if N == 0:
             return []
@@ -161,15 +238,58 @@ class SweepFabric:
 
         R = self.n_replicas
         lease = self.lease_size or max(1, int(slots))
-        queue = PartitionedTrialQueue(
-            N, R, lease_size=lease,
-            partitions=partitions if partitions is not None else self.partitions,
-        )
         out: list[Optional[str]] = [None] * N
         abort = threading.Event()
         cb_lock = threading.Lock()
         starts = steering_start_positions
         self._passes += 1
+        hb_stop: Optional[threading.Event] = None
+        if self._client is not None:
+            if trial_keys is None or pass_name is None:
+                raise ValueError(
+                    "multi-host fabric needs trial_keys and pass_name to "
+                    "recover trials decoded on other hosts from the "
+                    "shipped journals"
+                )
+            if len(trial_keys) != N:
+                raise ValueError(f"{len(trial_keys)} trial_keys for {N} prompts")
+            # Deterministic pass identity: every host computes the same id
+            # from the same grid (pass ordinal + trial-identity hash), so
+            # the coordinator's create-or-join can verify the fleet agrees
+            # on the work before issuing a single lease.
+            key_hash = zlib.crc32(
+                "\n".join(trial_keys).encode("utf-8")
+            ) & 0xFFFFFFFF
+            pass_id = f"p{self._passes}.n{N}.k{key_hash:08x}"
+            self._client.call("open_pass", {
+                "pass_id": pass_id, "n_items": N,
+                "n_workers": self.n_hosts * R, "lease_size": lease,
+            })
+
+            def _ship(_lease) -> None:
+                # Durability ordering: results reach shared storage BEFORE
+                # the lease is globally complete, so any host that later
+                # gap-fills a completed position always finds the record.
+                self.journals.ship()
+
+            queue = RemoteQueue(
+                self._client, pass_id,
+                worker_base=self.host_id * R,
+                before_complete=_ship, abort=abort,
+            )
+            hb_stop = threading.Event()
+            hb = threading.Thread(
+                target=self._heartbeat_loop,
+                args=(hb_stop, [self.host_id * R + k for k in range(R)]),
+                name=f"fabric-host{self.host_id}-heartbeat", daemon=True,
+            )
+            hb.start()
+        else:
+            queue = PartitionedTrialQueue(
+                N, R, lease_size=lease,
+                partitions=(partitions if partitions is not None
+                            else self.partitions),
+            )
 
         def decode(worker: ReplicaWorker, lease_obj) -> None:
             if self.journals is not None:
@@ -225,10 +345,14 @@ class SweepFabric:
             )
             for w in self.workers
         ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            if hb_stop is not None:
+                hb_stop.set()
         elapsed = time.perf_counter() - t0
 
         self._finish_stats(queue, elapsed, N)
@@ -242,6 +366,19 @@ class SweepFabric:
                 raise w.error if isinstance(w.error, SweepInterrupted) else (
                     SweepInterrupted("fabric sweep stopped")
                 )
+        if self._client is not None:
+            # The pass drained globally, so every position this host did
+            # not decode was completed by another host — and completion
+            # implies its journal shipped. Fill the gaps from the merged
+            # remote records.
+            gaps = [p for p, r in enumerate(out) if r is None]
+            if gaps:
+                self.journals.refresh()
+                decoded = self.journals.decoded(pass_name)
+                for p in gaps:
+                    rec = decoded.get(trial_keys[p])
+                    if rec is not None:
+                        out[p] = rec["response"]
         missing = sum(1 for r in out if r is None)
         if missing:
             raise RuntimeError(
@@ -252,12 +389,33 @@ class SweepFabric:
 
     # -- internals -----------------------------------------------------------
 
-    @staticmethod
-    def _faults_for(faults, replica_id: int):
-        """A fault plan with ``kill_replica`` set only afflicts that
-        replica; untargeted plans hit every replica (shared counters, so
-        e.g. crash_after_chunks fires once, fleet-wide)."""
+    def _heartbeat_loop(self, stop: threading.Event,
+                        worker_ids: list[int]) -> None:
+        """Per-pass liveness beat: ship journal snapshots (bounds how much
+        decode work a preemption can lose) and renew this host's lease
+        TTLs. Errors are swallowed — a missed beat just means the TTL gets
+        closer to expiring, and the main client's breaker owns the actual
+        drain decision."""
+        while not stop.wait(self.heartbeat_s):
+            try:
+                self.journals.ship()
+                self._hb_client.call("heartbeat", {
+                    "host": str(self.host_id),
+                    "workers": worker_ids,
+                    "metrics_url": self.metrics_url or "",
+                })
+            except Exception:  # noqa: BLE001 — liveness only, never fatal
+                pass
+
+    def _faults_for(self, faults, replica_id: int):
+        """A fault plan with ``kill_host`` set is inert on every other
+        host; ``kill_replica`` then scopes within the host. Untargeted
+        plans hit every replica (shared counters, so e.g.
+        crash_after_chunks fires once, fleet-wide)."""
         if faults is None:
+            return None
+        host_target = getattr(faults, "kill_host", None)
+        if host_target is not None and int(host_target) != self.host_id:
             return None
         target = getattr(faults, "kill_replica", None)
         if target is not None and int(target) != replica_id:

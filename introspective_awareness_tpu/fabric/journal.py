@@ -1,4 +1,4 @@
-"""Per-replica trial journals with merged replay.
+"""Per-replica trial journals with merged replay (single- and multi-host).
 
 Each fabric replica appends to its OWN :class:`TrialJournal`
 (``trial_journal.replica<k>.jsonl``) so the decode hot path never
@@ -10,6 +10,22 @@ would from one journal. Resuming with a different replica count (including
 one) is therefore safe and bit-identical; journals left by extra replicas
 of a previous run are discovered and merged too.
 
+Multi-host mode (``host_id`` given) adds the shipping layer the pod-scale
+fabric needs. Each host writes its journals to a LOCAL spool
+(``spool_dir`` — preemptible scratch disk) under host-qualified names
+(``trial_journal.host<h>.replica<k>.jsonl``) and *ships* them to shared
+storage (``base_path``'s directory) with tmp + fsync + ``os.replace``, so
+a shipped file is always a whole CRC-valid snapshot — a host killed
+mid-ship leaves at most an ignored ``.tmp`` (torn-ship detection), never
+a half-replaced journal. On startup a host adopts its OWN previous
+shipped files by copying them into the spool (so its prior records
+survive a second crash through the next ship), while every OTHER
+discovered journal is parsed as a READ-ONLY merge source — never opened
+for write, compacted, or rewritten, because its owner may be alive and
+shipping concurrently. ``refresh()`` re-reads the merge sources mid-run;
+the fabric uses it to fill in trials decoded by remote hosts after a
+pass drains.
+
 :class:`FabricJournalSet` mirrors the TrialJournal API that the protocol
 and CLI layers consume, plus ``bind_replica`` — worker threads bind their
 replica id thread-locally so ``record_*`` lands in their own file (threads
@@ -19,12 +35,105 @@ identity keys merge regardless of which file holds a record).
 
 from __future__ import annotations
 
+import os
+import shutil
 import threading
 from pathlib import Path
 from typing import Optional
 
 from introspective_awareness_tpu.obs.recovery import RecoveryGauges
-from introspective_awareness_tpu.runtime.journal import TrialJournal
+from introspective_awareness_tpu.runtime.journal import (
+    JournalConfigMismatch,
+    JournalError,
+    TrialJournal,
+    _parse_line,
+)
+
+
+class _ReadOnlyJournal:
+    """Replayed state of another host's shipped journal — never written.
+
+    Parsing mirrors :class:`TrialJournal` replay (CRC framing, torn-tail
+    drop, refuse mid-file corruption, config-signature validation) but
+    opens nothing for write: the owning host may replace the file at any
+    moment, and two hosts rewriting each other's journals is exactly the
+    race the ship protocol exists to prevent.
+    """
+
+    def __init__(self, path: Path, config: dict) -> None:
+        self.path = Path(path)
+        self.config = config
+        self.decoded_by_pass: dict[str, dict] = {}
+        self.graded_by_pass: dict[str, dict] = {}
+        self.deferred_by_pass: dict[str, dict] = {}
+        self.regraded_cells: set[tuple] = set()
+        self.was_clean_stop = False
+        self.records = 0
+        self.torn_dropped = 0
+        self._parse()
+
+    def _parse(self) -> None:
+        raw = self.path.read_bytes()
+        records: list[dict] = []
+        bad_at: Optional[int] = None
+        lines = raw.splitlines(keepends=True)
+        for i, ln in enumerate(lines):
+            rec = _parse_line(ln)
+            if rec is None:
+                if bad_at is None:
+                    bad_at = i
+                continue
+            if bad_at is not None:
+                raise JournalError(
+                    f"{self.path}: corrupt record at line {bad_at + 1} "
+                    f"followed by valid records — shipped journal damaged "
+                    f"beyond torn-tail recovery"
+                )
+            records.append(rec)
+        if bad_at is not None:
+            self.torn_dropped = len(lines) - bad_at
+        if not records:
+            return
+        head = records[0]
+        if head.get("ev") != "start":
+            raise JournalError(
+                f"{self.path}: first record is {head.get('ev')!r}, not the "
+                f"'start' config signature — not a trial journal"
+            )
+        if head.get("schema") != TrialJournal.SCHEMA:
+            raise JournalConfigMismatch(
+                f"{self.path} uses journal schema {head.get('schema')!r}, "
+                f"this reader uses {TrialJournal.SCHEMA}"
+            )
+        if head.get("config") != self.config:
+            theirs = head.get("config") or {}
+            diff = sorted(
+                k for k in set(theirs) | set(self.config)
+                if theirs.get(k) != self.config.get(k)
+            )
+            raise JournalConfigMismatch(
+                f"{self.path} was shipped by a sweep with a different "
+                f"configuration (differing keys: {diff})"
+            )
+        for rec in records[1:]:
+            ev = rec.get("ev")
+            if ev == "decoded":
+                self.decoded_by_pass.setdefault(rec["pass"], {})[
+                    rec["idx"]] = rec["result"]
+            elif ev == "graded":
+                self.graded_by_pass.setdefault(rec["pass"], {})[
+                    rec["idx"]] = rec["evaluations"]
+            elif ev == "grade_deferred":
+                self.deferred_by_pass.setdefault(rec["pass"], {})[
+                    rec["idx"]] = rec
+            elif ev == "cell_regraded":
+                self.regraded_cells.add(tuple(rec["cell"]))
+        self.records = len(records) - 1
+        self.was_clean_stop = records[-1].get("ev") == "clean_stop"
+
+    def has_state(self) -> bool:
+        return bool(self.decoded_by_pass or self.graded_by_pass
+                    or self.deferred_by_pass)
 
 
 class FabricJournalSet:
@@ -36,28 +145,72 @@ class FabricJournalSet:
         config: dict,
         n_replicas: int,
         fsync_every: int = 16,
+        host_id: Optional[int] = None,
+        spool_dir: Optional[Path | str] = None,
     ) -> None:
         base = Path(base_path)
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.n_replicas = int(n_replicas)
-        paths = [self.replica_path(base, k) for k in range(self.n_replicas)]
-        # A previous run may have used MORE replicas: merge its extra
-        # journals too (read + compact/discard lifecycle, never written to).
-        extras = [p for p in self.discover(base) if p not in paths]
-        self.journals = [
-            TrialJournal(p, config, fsync_every=fsync_every)
-            for p in paths + extras
-        ]
+        self.host_id = None if host_id is None else int(host_id)
+        self.multihost = self.host_id is not None
+        self._ship_lock = threading.Lock()
+        self._closed = False
+        self._base = base
+        self._sources: list[_ReadOnlyJournal] = []
+
+        if not self.multihost:
+            self._spool: Optional[Path] = None
+            paths = [self.replica_path(base, k)
+                     for k in range(self.n_replicas)]
+            # A previous run may have used MORE replicas (or hosts): merge
+            # its extra journals too (read + compact/discard lifecycle,
+            # never written to). Safe to open writable — nothing else is
+            # alive in single-host mode.
+            extras = [p for p in self.discover(base) if p not in paths]
+            self.journals = [
+                TrialJournal(p, config, fsync_every=fsync_every)
+                for p in paths + extras
+            ]
+            self._shipped: list[Path] = []
+        else:
+            if spool_dir is None:
+                raise ValueError("multi-host journals need a spool_dir")
+            self._spool = Path(spool_dir)
+            self._spool.mkdir(parents=True, exist_ok=True)
+            base.parent.mkdir(parents=True, exist_ok=True)
+            names = [
+                self.host_replica_name(base, self.host_id, k)
+                for k in range(self.n_replicas)
+            ]
+            self._shipped = [base.parent / n for n in names]
+            spooled = [self._spool / n for n in names]
+            # Adopt our OWN previous shipped files: copy into the spool so
+            # TrialJournal replays them and the next ship re-publishes the
+            # prior records (they survive a second crash). Other hosts'
+            # files are strictly read-only merge sources below.
+            for shipped, spool in zip(self._shipped, spooled):
+                if shipped.exists() and not spool.exists():
+                    shutil.copyfile(shipped, spool)
+            self.journals = [
+                TrialJournal(p, config, fsync_every=fsync_every)
+                for p in spooled
+            ]
+            self._refresh_sources(self.journals[0].config)
+
         self.config = self.journals[0].config
-        self.path = str(self.replica_path(base, "*"))
+        self.path = (str(self.replica_path(base, "*")) if not self.multihost
+                     else str(base.parent / self.host_replica_name(
+                         base, self.host_id, "*")))
         self._tl = threading.local()
 
-        self.resumed = any(j.resumed for j in self.journals)
+        self.resumed = (any(j.resumed for j in self.journals)
+                        or any(s.records for s in self._sources))
         resumed = [j for j in self.journals if j.resumed]
-        self.was_clean_stop = bool(resumed) and all(
-            j.was_clean_stop for j in resumed
-        )
+        clean_flags = [j.was_clean_stop for j in resumed] + [
+            s.was_clean_stop for s in self._sources if s.records
+        ]
+        self.was_clean_stop = bool(clean_flags) and all(clean_flags)
         self.gauges = RecoveryGauges()
         for j in self.journals:
             self.gauges.replayed_records += j.gauges.replayed_records
@@ -65,6 +218,15 @@ class FabricJournalSet:
             self.gauges.recovered_grades += j.gauges.recovered_grades
             self.gauges.torn_records_dropped += j.gauges.torn_records_dropped
             self.gauges.deferred_grades += j.gauges.deferred_grades
+        for s in self._sources:
+            self.gauges.replayed_records += s.records
+            self.gauges.recovered_trials += sum(
+                len(m) for m in s.decoded_by_pass.values()
+            )
+            self.gauges.recovered_grades += sum(
+                len(m) for m in s.graded_by_pass.values()
+            )
+            self.gauges.torn_records_dropped += s.torn_dropped
         self.gauges.clean_stop = self.was_clean_stop
 
     # -- path scheme ---------------------------------------------------------
@@ -74,15 +236,44 @@ class FabricJournalSet:
         base = Path(base)
         return base.with_name(f"{base.stem}.replica{k}{base.suffix}")
 
+    @staticmethod
+    def host_replica_name(base: Path, h, k) -> str:
+        base = Path(base)
+        return f"{base.stem}.host{h}.replica{k}{base.suffix}"
+
     @classmethod
     def discover(cls, base: Path | str) -> list[Path]:
-        """Existing replica journal files for ``base``, sorted by replica."""
+        """Existing replica journal files for ``base`` — both the
+        single-host (``.replica<k>``) and multi-host
+        (``.host<h>.replica<k>``) naming — sorted by name. Leftover
+        ``.tmp`` ship files (a host killed mid-ship) are ignored: the
+        torn-ship detection half of the atomic-publish contract."""
         base = Path(base)
         found = sorted(
-            base.parent.glob(f"{base.stem}.replica*{base.suffix}"),
+            set(base.parent.glob(f"{base.stem}.replica*{base.suffix}"))
+            | set(base.parent.glob(
+                f"{base.stem}.host*.replica*{base.suffix}")),
             key=lambda p: p.name,
         )
         return [p for p in found if not p.name.endswith(".tmp")]
+
+    def _refresh_sources(self, config: dict) -> None:
+        """(Re-)parse every discovered journal we do not own as a
+        read-only merge source. Files may vanish mid-scan (their owner
+        discarded them, or a rename raced the glob) — re-glob once."""
+        own = set(self._shipped)
+        for _ in range(3):
+            sources = []
+            try:
+                for p in self.discover(self._base):
+                    if p in own:
+                        continue
+                    sources.append(_ReadOnlyJournal(p, config))
+            except FileNotFoundError:
+                continue
+            self._sources = sources
+            return
+        self._sources = []
 
     # -- replica routing -----------------------------------------------------
 
@@ -93,6 +284,45 @@ class FabricJournalSet:
     def _writer(self) -> TrialJournal:
         k = getattr(self._tl, "replica", 0)
         return self.journals[k if 0 <= k < self.n_replicas else 0]
+
+    # -- shipping (multi-host) ----------------------------------------------
+
+    def ship(self) -> int:
+        """Atomically publish each spooled journal to shared storage.
+
+        Snapshot-copies every own journal under its file lock (a
+        consistent whole-record prefix), writes the snapshot next to the
+        target as ``.tmp``, fsyncs, and ``os.replace``s — readers only
+        ever see a whole old or whole new file. No-op after close/discard
+        (so a late heartbeat can't resurrect a discarded journal) and in
+        single-host mode. Returns the number of files shipped."""
+        if not self.multihost or self._closed:
+            return 0
+        with self._ship_lock:
+            if self._closed:
+                return 0
+            shipped = 0
+            for j, target in zip(self.journals, self._shipped):
+                with j._lock:  # consistent snapshot (same-package coupling)
+                    if j._f.closed:
+                        continue
+                    j._f.flush()
+                    data = j.path.read_bytes()
+                tmp = target.with_name(target.name + ".tmp")
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, target)
+                shipped += 1
+            return shipped
+
+    def refresh(self) -> None:
+        """Re-read the other hosts' shipped journals (the fabric calls
+        this after a pass globally drains, to fill remote-decoded
+        trials)."""
+        if self.multihost:
+            self._refresh_sources(self.config)
 
     # -- TrialJournal facade: appends ---------------------------------------
 
@@ -115,33 +345,61 @@ class FabricJournalSet:
         # Every file gets the marker: each replays independently on resume.
         for j in self.journals:
             j.record_clean_stop()
+        self.ship()
 
     def flush(self) -> None:
         for j in self.journals:
             j.flush()
+        self.ship()
 
     def close(self) -> None:
         for j in self.journals:
             j.close()
+        self._closed = True
 
     def compact(self) -> None:
+        # Own journals only: merge sources belong to other hosts.
         for j in self.journals:
             j.compact()
 
     def discard(self) -> None:
-        for j in self.journals:
-            j.discard()
+        """The sweep completed with everything persisted in final
+        artifacts. Drops spool AND shipped files, plus merge-source files
+        (obsolete once every cell is saved; hosts race these deletes —
+        missing files are fine)."""
+        with self._ship_lock:
+            self._closed = True
+            for j in self.journals:
+                j.discard()
+            for p in self._shipped + [s.path for s in self._sources]:
+                try:
+                    p.unlink()
+                except FileNotFoundError:
+                    pass
+            if self._spool is not None:
+                try:
+                    self._spool.rmdir()
+                except OSError:
+                    pass
+
+    @property
+    def fsync_failed(self) -> bool:
+        return any(j.fsync_failed for j in self.journals)
 
     # -- TrialJournal facade: merged replayed state -------------------------
 
     def decoded(self, pass_key: str) -> dict:
         out: dict = {}
+        for s in self._sources:
+            out.update(s.decoded_by_pass.get(pass_key, {}))
         for j in self.journals:
             out.update(j.decoded(pass_key))
         return out
 
     def graded(self, pass_key: str) -> dict:
         out: dict = {}
+        for s in self._sources:
+            out.update(s.graded_by_pass.get(pass_key, {}))
         for j in self.journals:
             out.update(j.graded(pass_key))
         return out
@@ -149,6 +407,10 @@ class FabricJournalSet:
     def deferred(self, pass_key: str) -> dict:
         graded = self.graded(pass_key)
         out: dict = {}
+        for s in self._sources:
+            for idx, rec in s.deferred_by_pass.get(pass_key, {}).items():
+                if idx not in graded:
+                    out[idx] = rec
         for j in self.journals:
             for idx, rec in j.deferred(pass_key).items():
                 if idx not in graded:
@@ -163,7 +425,16 @@ class FabricJournalSet:
             # A cell regraded through ANY replica's file is resolved for the
             # whole set (private member, same-package coupling by design).
             regraded |= j._regraded_cells
+        for s in self._sources:
+            for pass_key, recs in s.deferred_by_pass.items():
+                for idx, rec in recs.items():
+                    if idx in s.graded_by_pass.get(pass_key, {}):
+                        continue
+                    if rec.get("cell"):
+                        cells.add(tuple(rec["cell"]))
+            regraded |= s.regraded_cells
         return cells - regraded
 
     def has_state(self) -> bool:
-        return any(j.has_state() for j in self.journals)
+        return (any(j.has_state() for j in self.journals)
+                or any(s.has_state() for s in self._sources))

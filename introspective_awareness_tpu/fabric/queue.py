@@ -12,15 +12,21 @@ Lease semantics: an acquired lease is owned until ``complete`` or
 ``fail``. Only un-leased tail blocks are stealable; a worker that dies
 mid-lease fails it back to its home partition, and the fabric's abort
 path plus the per-replica journals cover whatever the crashed run left
-undone. Stdlib-only and lock-protected — workers are threads.
+undone. With ``lease_ttl_s`` set, a lease that is neither completed nor
+renewed (``touch``) within the TTL is *expired* — requeued to the front
+of its home partition so surviving workers pick it up in queue order.
+That closes the wedged-worker leak (a worker that never calls ``fail``)
+and is the same mechanism the RPC coordinator drives from host
+heartbeats. Stdlib-only and lock-protected — workers are threads.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 
 @dataclass
@@ -32,6 +38,7 @@ class WorkLease:
     home: int               # partition the indices came from
     indices: list[int]      # global queue positions, in queue order
     stolen: bool = False
+    deadline: Optional[float] = None   # clock() time after which expirable
 
 
 @dataclass
@@ -43,6 +50,7 @@ class QueueStats:
     stolen_trials: int = 0
     completed_trials: int = 0
     failed_leases: int = 0
+    expired_leases: int = 0   # TTL requeues (wedged / dead holder)
     peak_skew: int = 0        # max-min partition backlog seen at any acquire
 
     def as_stats(self) -> dict:
@@ -52,6 +60,7 @@ class QueueStats:
             "stolen_trials": self.stolen_trials,
             "completed_trials": self.completed_trials,
             "failed_leases": self.failed_leases,
+            "expired_leases": self.expired_leases,
             "peak_queue_skew": self.peak_skew,
         }
 
@@ -70,12 +79,18 @@ class PartitionedTrialQueue:
         n_replicas: int,
         lease_size: int = 1,
         partitions: Optional[Sequence[Sequence[int]]] = None,
+        lease_ttl_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
+        if lease_ttl_s is not None and lease_ttl_s <= 0:
+            raise ValueError("lease_ttl_s must be positive")
         self.n_items = int(n_items)
         self.n_replicas = int(n_replicas)
         self.lease_size = max(1, int(lease_size))
+        self.lease_ttl_s = lease_ttl_s
+        self._clock = clock
         if partitions is None:
             bounds = [
                 round(k * self.n_items / self.n_replicas)
@@ -102,7 +117,63 @@ class PartitionedTrialQueue:
         self._outstanding: dict[int, WorkLease] = {}
         self.stats = QueueStats()
 
+    @classmethod
+    def restore(
+        cls,
+        n_items: int,
+        n_replicas: int,
+        lease_size: int,
+        partitions: Sequence[Sequence[int]],
+        outstanding: Sequence[WorkLease],
+        next_lease: int,
+        lease_ttl_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        stats: Optional[QueueStats] = None,
+    ) -> "PartitionedTrialQueue":
+        """Rebuild a queue mid-flight from replayed coordinator WAL state.
+
+        Unlike ``__init__``, ``partitions`` is *partial* — positions held
+        by outstanding leases or already completed are absent. Restored
+        leases keep their ids (``_next_lease`` continues past them) and
+        get a FRESH TTL deadline, so a coordinator restart renews rather
+        than instantly expires in-flight work."""
+        q = cls.__new__(cls)
+        q.n_items = int(n_items)
+        q.n_replicas = int(n_replicas)
+        q.lease_size = max(1, int(lease_size))
+        q.lease_ttl_s = lease_ttl_s
+        q._clock = clock
+        q._parts = [deque(p) for p in partitions]
+        q._lock = threading.Lock()
+        q._next_lease = int(next_lease)
+        q._outstanding = {}
+        for lease in outstanding:
+            if lease_ttl_s is not None:
+                lease.deadline = clock() + lease_ttl_s
+            q._outstanding[lease.lease_id] = lease
+        q.stats = stats if stats is not None else QueueStats()
+        return q
+
     # -- claim / release -----------------------------------------------------
+
+    def _expire_locked(self) -> None:
+        """Requeue every outstanding lease past its deadline (lock held).
+
+        Expired indices go to the FRONT of the lease's home partition —
+        the same placement as ``fail`` — so the recovery order matches a
+        worker that died cleanly. The stale holder's late ``complete`` /
+        ``fail`` is a no-op (its lease_id is gone from outstanding)."""
+        if self.lease_ttl_s is None or not self._outstanding:
+            return
+        now = self._clock()
+        dead = [
+            l for l in self._outstanding.values()
+            if l.deadline is not None and now >= l.deadline
+        ]
+        for lease in dead:
+            del self._outstanding[lease.lease_id]
+            self._parts[lease.home].extendleft(reversed(lease.indices))
+            self.stats.expired_leases += 1
 
     def acquire(self, replica: int) -> Optional[WorkLease]:
         """Claim the next lease for ``replica``: from its own partition's
@@ -110,6 +181,7 @@ class PartitionedTrialQueue:
         Returns None when every partition is empty (outstanding leases may
         still be in flight — the caller's join handles those)."""
         with self._lock:
+            self._expire_locked()
             backlogs = [len(p) for p in self._parts]
             if any(backlogs):
                 self.stats.peak_skew = max(
@@ -138,10 +210,28 @@ class PartitionedTrialQueue:
                 )
                 self.stats.steals += 1
                 self.stats.stolen_trials += take
+            if self.lease_ttl_s is not None:
+                lease.deadline = self._clock() + self.lease_ttl_s
             self._next_lease += 1
             self._outstanding[lease.lease_id] = lease
             self.stats.leases += 1
             return lease
+
+    def touch(self, replica: Optional[int] = None) -> int:
+        """Renew the TTL deadline on outstanding leases (heartbeat path).
+
+        ``replica=None`` renews every lease; otherwise only those held by
+        that worker. Returns the number of leases renewed."""
+        if self.lease_ttl_s is None:
+            return 0
+        with self._lock:
+            now = self._clock()
+            renewed = 0
+            for lease in self._outstanding.values():
+                if replica is None or lease.replica == replica:
+                    lease.deadline = now + self.lease_ttl_s
+                    renewed += 1
+            return renewed
 
     def complete(self, lease: WorkLease) -> None:
         with self._lock:
@@ -162,6 +252,7 @@ class PartitionedTrialQueue:
     def remaining(self) -> int:
         """Un-leased positions still in partitions."""
         with self._lock:
+            self._expire_locked()
             return sum(len(p) for p in self._parts)
 
     def backlog(self, replica: int) -> int:
@@ -170,4 +261,12 @@ class PartitionedTrialQueue:
 
     def outstanding(self) -> int:
         with self._lock:
+            self._expire_locked()
             return len(self._outstanding)
+
+    def outstanding_ids(self) -> set[int]:
+        """Lease ids still in flight (after TTL expiry) — the coordinator
+        diffs this against its own lease table to detect expirations."""
+        with self._lock:
+            self._expire_locked()
+            return set(self._outstanding)

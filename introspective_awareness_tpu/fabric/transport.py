@@ -1,0 +1,311 @@
+"""Stdlib JSON-RPC transport for the multi-host sweep coordinator.
+
+One POST endpoint (``/rpc``) carries every queue operation as a JSON
+body ``{"method", "params", "req_id"}``; responses are
+``{"ok": true, "result": ...}`` or ``{"ok": false, "error", "retryable"}``.
+GET routes (``/metrics``, ``/progress``, ``/healthz``) are pluggable so
+the coordinator can federate host telemetry on the same port.
+
+The client retries every call with the judge-client backoff shape —
+exponential delay lifted by jitter — but adds a hard **backoff ceiling**
+and a stable ``req_id`` per logical operation, so a retry after a lost
+response is idempotent server-side (the coordinator replays the cached
+response instead of double-issuing a lease). A small circuit breaker
+sits in front: after ``breaker_threshold`` consecutive failed *calls*
+(retries exhausted) the client raises ``CoordinatorUnavailable``
+immediately, which the worker host turns into drain-and-exit rather
+than crashing the fleet; a half-open probe after ``breaker_cooldown_s``
+lets one call test recovery.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from introspective_awareness_tpu.obs.registry import default_registry
+
+
+class RpcFault(Exception):
+    """Application-level failure raised by a dispatch handler.
+
+    ``retryable=False`` (the default) means the client should surface it
+    immediately — retrying a semantic error (unknown pass, config
+    mismatch) cannot succeed.
+    """
+
+    def __init__(self, message: str, retryable: bool = False) -> None:
+        super().__init__(message)
+        self.retryable = retryable
+
+
+class CoordinatorUnavailable(RuntimeError):
+    """The RPC circuit breaker is open — the coordinator is unreachable."""
+
+
+# -- server -------------------------------------------------------------------
+
+
+class RpcTransportServer:
+    """ThreadingHTTPServer hosting one dispatch callable plus GET routes.
+
+    ``dispatch(method, params, req_id)`` returns a JSON-serializable
+    result or raises ``RpcFault``. ``get_routes`` maps a path to a
+    zero-arg callable returning ``(status, content_type, body_bytes)``.
+    ``on_request`` fires before each request is handled — the
+    coordinator hooks its ``kill_coordinator_after`` fault tick here.
+    """
+
+    def __init__(
+        self,
+        dispatch: Callable[[str, dict, Optional[str]], dict],
+        get_routes: Optional[dict] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        on_request: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self._dispatch = dispatch
+        self._get_routes = dict(get_routes or {})
+        self._host = host
+        self._want_port = port
+        self._on_request = on_request
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self) -> "RpcTransportServer":
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: D102 — silence stderr
+                pass
+
+            def _send(self, status: int, ctype: str, body: bytes) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if outer._on_request is not None:
+                    outer._on_request()
+                path = self.path.split("?", 1)[0]
+                route = outer._get_routes.get(path)
+                if route is None:
+                    self._send(404, "text/plain", b"not found\n")
+                    return
+                status, ctype, body = route()
+                self._send(status, ctype, body)
+
+            def do_POST(self):  # noqa: N802
+                if outer._on_request is not None:
+                    outer._on_request()
+                if self.path.split("?", 1)[0] != "/rpc":
+                    self._send(404, "text/plain", b"not found\n")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    msg = json.loads(self.rfile.read(n).decode("utf-8"))
+                    method = msg["method"]
+                    params = msg.get("params") or {}
+                    req_id = msg.get("req_id")
+                except (ValueError, KeyError, UnicodeDecodeError) as e:
+                    doc = {"ok": False, "error": f"bad request: {e}",
+                           "retryable": False}
+                    self._send(400, "application/json",
+                               json.dumps(doc).encode())
+                    return
+                try:
+                    result = outer._dispatch(method, params, req_id)
+                    doc = {"ok": True, "result": result}
+                    status = 200
+                except RpcFault as e:
+                    doc = {"ok": False, "error": str(e),
+                           "retryable": e.retryable}
+                    status = 503 if e.retryable else 409
+                except Exception as e:  # noqa: BLE001 — surface, retryable
+                    doc = {"ok": False, "error": f"{type(e).__name__}: {e}",
+                           "retryable": True}
+                    status = 500
+                self._send(status, "application/json",
+                           json.dumps(doc).encode())
+
+        self._server = ThreadingHTTPServer(
+            (self._host, self._want_port), _Handler
+        )
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="rpc-transport",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+# -- client -------------------------------------------------------------------
+
+
+class RpcClient:
+    """Retrying JSON-RPC client with idempotency keys and a breaker.
+
+    Each ``call`` mints ONE ``req_id`` and reuses it across every retry
+    of that call, so a response lost to a timeout is replayed from the
+    coordinator's idempotency cache rather than re-executed. Backoff is
+    the judge-client shape (``base * 2**attempt`` plus 0–25% jitter)
+    clamped to ``backoff_ceiling_s``.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        timeout_s: float = 10.0,
+        max_retries: int = 5,
+        backoff_base_s: float = 0.5,
+        backoff_ceiling_s: float = 30.0,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 10.0,
+        client_id: Optional[str] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        registry=None,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_ceiling_s = backoff_ceiling_s
+        self._sleep = sleep
+        self._client_id = client_id or f"c{random.randrange(16**8):08x}"
+        self._seq = 0
+        self._lock = threading.Lock()
+        # Breaker state: consecutive failed calls; open until cooldown.
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooldown_s = breaker_cooldown_s
+        self._consecutive_failures = 0
+        self._open_until: Optional[float] = None
+        self._half_open_probe = False
+        reg = registry if registry is not None else default_registry()
+        self._g_breaker = reg.gauge(
+            "iat_coordinator_breaker_state",
+            "Coordinator RPC breaker: 0 closed, 1 open, 2 half-open",
+        )
+        self._c_retries = reg.counter(
+            "iat_coordinator_rpc_retries_total",
+            "Coordinator RPC attempts beyond the first, by method",
+            labelnames=("method",),
+        )
+
+    # Separated for tests: monkeypatch _send to simulate a response lost
+    # after the server processed the request.
+    def _send(self, payload: bytes) -> dict:
+        req = urllib.request.Request(
+            self.base_url + "/rpc", data=payload,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def _backoff(self, attempt: int) -> float:
+        delay = min(
+            self.backoff_base_s * (2 ** attempt), self.backoff_ceiling_s
+        )
+        return delay + random.uniform(0, 0.25 * delay)
+
+    def _breaker_admit(self) -> None:
+        with self._lock:
+            if self._open_until is None:
+                return
+            now = time.monotonic()
+            if now < self._open_until:
+                self._g_breaker.set(1)
+                raise CoordinatorUnavailable(
+                    f"coordinator {self.base_url} unreachable "
+                    f"(circuit open after {self._consecutive_failures} "
+                    f"consecutive failed calls)"
+                )
+            if self._half_open_probe:
+                raise CoordinatorUnavailable(
+                    f"coordinator {self.base_url} unreachable "
+                    "(half-open probe already in flight)"
+                )
+            self._half_open_probe = True
+            self._g_breaker.set(2)
+
+    def _breaker_record(self, ok: bool) -> None:
+        with self._lock:
+            self._half_open_probe = False
+            if ok:
+                self._consecutive_failures = 0
+                self._open_until = None
+                self._g_breaker.set(0)
+            else:
+                self._consecutive_failures += 1
+                if self._consecutive_failures >= self._breaker_threshold:
+                    self._open_until = (
+                        time.monotonic() + self._breaker_cooldown_s
+                    )
+                    self._g_breaker.set(1)
+
+    def call(self, method: str, params: Optional[dict] = None) -> dict:
+        """POST one logical operation; retry transient failures with the
+        same req_id. Raises ``RpcFault`` on non-retryable application
+        errors and ``CoordinatorUnavailable`` once the breaker opens."""
+        self._breaker_admit()
+        with self._lock:
+            self._seq += 1
+            req_id = f"{self._client_id}:{self._seq}"
+        payload = json.dumps(
+            {"method": method, "params": params or {}, "req_id": req_id}
+        ).encode("utf-8")
+        last_error: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self._c_retries.inc(method=method)
+                self._sleep(self._backoff(attempt - 1))
+            try:
+                doc = self._send(payload)
+            except urllib.error.HTTPError as e:
+                # Transport-level HTTP error: the body may still carry an
+                # app-level doc (409 non-retryable faults arrive here).
+                try:
+                    doc = json.loads(e.read().decode("utf-8"))
+                except Exception:  # noqa: BLE001 — opaque 5xx, retry
+                    last_error = e
+                    continue
+            except (urllib.error.URLError, socket.timeout,
+                    ConnectionError, TimeoutError) as e:
+                last_error = e
+                continue
+            if doc.get("ok"):
+                self._breaker_record(True)
+                return doc.get("result") or {}
+            if doc.get("retryable"):
+                last_error = RpcFault(doc.get("error", "server error"),
+                                      retryable=True)
+                continue
+            # Non-retryable application fault: does not trip the breaker
+            # (the coordinator is alive and answering).
+            self._breaker_record(True)
+            raise RpcFault(doc.get("error", "server error"))
+        self._breaker_record(False)
+        raise CoordinatorUnavailable(
+            f"coordinator {self.base_url} unreachable after "
+            f"{self.max_retries + 1} attempts: {last_error}"
+        )

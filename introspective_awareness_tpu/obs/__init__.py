@@ -69,12 +69,14 @@ from introspective_awareness_tpu.obs.timing import (
 )
 from introspective_awareness_tpu.obs.http import (
     AggregateProgress,
+    HealthState,
     MetricsServer,
     ProgressTracker,
 )
 from introspective_awareness_tpu.obs.registry import (
     MetricsRegistry,
     default_registry,
+    render_federated,
 )
 from introspective_awareness_tpu.obs.trace import ChunkTrace, format_attribution
 
@@ -84,6 +86,7 @@ __all__ = [
     "ChunkTrace",
     "CompileAccounting",
     "HbmPreflightError",
+    "HealthState",
     "MetricsRegistry",
     "MetricsServer",
     "NullLedger",
@@ -108,6 +111,7 @@ __all__ = [
     "preflight",
     "preflight_skip",
     "profile_trace",
+    "render_federated",
     "scan_hlo_temps",
     "timed",
     "top_temp_buffers",

@@ -11,7 +11,12 @@ and stored on the server), serving
   done/total, evals/s, ETA, phase, plus every single-series gauge and
   counter in the registry (slot occupancy, breaker state, journal
   counts) without per-endpoint wiring;
-- ``GET /healthz``  — liveness probe.
+- ``GET /registry`` — the registry ``snapshot()`` as JSON (the pull
+  source for the multi-host coordinator's federated ``/metrics``);
+- ``GET /healthz``  — liveness + degradation probe: 200 ``ok`` while
+  every registered :class:`HealthState` probe is clean, 503
+  ``degraded: <reasons>`` when any fires (judge breaker open, journal
+  fsync failure, dead fabric worker).
 
 The server runs daemon-threaded (``ThreadingHTTPServer``), so a hung
 scrape can never wedge the scheduler; ``stop()`` is idempotent and the
@@ -32,6 +37,37 @@ from introspective_awareness_tpu.obs.registry import (
 )
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class HealthState:
+    """Named degradation probes behind ``/healthz``.
+
+    Each probe is a zero-arg callable returning ``None`` (healthy) or a
+    short reason string. Probes are late-bound and exception-safe: a
+    probe that raises reads as degraded with the exception named, so a
+    broken probe can never make an unhealthy process look healthy."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._probes: dict[str, Callable[[], Optional[str]]] = {}
+
+    def add_probe(self, name: str,
+                  fn: Callable[[], Optional[str]]) -> None:
+        with self._lock:
+            self._probes[str(name)] = fn
+
+    def reasons(self) -> list[str]:
+        with self._lock:
+            probes = dict(self._probes)
+        out: list[str] = []
+        for name, fn in sorted(probes.items()):
+            try:
+                r = fn()
+            except Exception as e:  # noqa: BLE001 — degraded, not hidden
+                r = f"probe raised {type(e).__name__}: {e}"
+            if r:
+                out.append(f"{name}: {r}")
+        return out
 
 
 class ProgressTracker:
@@ -193,9 +229,11 @@ class MetricsServer:
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  progress: Optional[ProgressTracker] = None,
-                 port: int = 0, host: str = "127.0.0.1") -> None:
+                 port: int = 0, host: str = "127.0.0.1",
+                 health: Optional[HealthState] = None) -> None:
         self.registry = registry if registry is not None else default_registry()
         self.progress = progress
+        self.health = health if health is not None else HealthState()
         self._host = host
         self._want_port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -213,6 +251,7 @@ class MetricsServer:
 
     def start(self) -> "MetricsServer":
         registry, progress = self.registry, self.progress
+        health = self.health
 
         class _Handler(BaseHTTPRequestHandler):
             def log_message(self, *a: Any) -> None:  # silence stderr spam
@@ -234,8 +273,16 @@ class MetricsServer:
                     self._send(200, "application/json",
                                json.dumps(_progress_doc(
                                    registry, progress)).encode())
+                elif path == "/registry":
+                    self._send(200, "application/json",
+                               json.dumps(registry.snapshot()).encode())
                 elif path == "/healthz":
-                    self._send(200, "text/plain", b"ok\n")
+                    reasons = health.reasons()
+                    if reasons:
+                        body = ("degraded: " + "; ".join(reasons) + "\n")
+                        self._send(503, "text/plain", body.encode())
+                    else:
+                        self._send(200, "text/plain", b"ok\n")
                 else:
                     self._send(404, "text/plain", b"not found\n")
 
@@ -267,6 +314,7 @@ class MetricsServer:
 
 __all__ = [
     "AggregateProgress",
+    "HealthState",
     "MetricsServer",
     "ProgressTracker",
     "PROM_CONTENT_TYPE",

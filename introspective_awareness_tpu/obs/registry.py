@@ -317,6 +317,52 @@ class MetricsRegistry:
         return out
 
 
+def render_federated(host_snapshots: dict[str, dict]) -> str:
+    """Merge per-host ``snapshot()`` docs into one Prometheus text doc.
+
+    The multi-host coordinator pulls each worker host's ``/registry``
+    JSON and serves the union under its own ``/metrics``, every series
+    re-labeled with ``host="<h>"``. HELP/TYPE lines come from the first
+    host exposing each metric; hosts are rendered in sorted order so the
+    exposition is deterministic for tests."""
+    order: list[str] = []
+    merged: dict[str, dict[str, Any]] = {}
+    for host in sorted(host_snapshots):
+        doc = host_snapshots[host] or {}
+        for name, m in (doc.get("metrics") or {}).items():
+            if name not in merged:
+                merged[name] = {"type": m.get("type", "untyped"),
+                                "help": m.get("help", ""), "rows": []}
+                order.append(name)
+            for row in m.get("series") or []:
+                merged[name]["rows"].append((host, row))
+    lines: list[str] = []
+    for name in order:
+        m = merged[name]
+        if m["help"]:
+            lines.append(f"# HELP {name} {m['help']}")
+        lines.append(f"# TYPE {name} {m['type']}")
+        for host, row in m["rows"]:
+            labels = {"host": str(host), **(row.get("labels") or {})}
+            lab = ",".join(
+                f'{k}="{_escape(str(v))}"' for k, v in labels.items()
+            )
+            if m["type"] == "histogram":
+                cum = 0
+                for le, c in (row.get("buckets") or {}).items():
+                    cum += c
+                    lines.append(
+                        f'{name}_bucket{{{lab},le="{le}"}} {cum}'
+                    )
+                lines.append(f"{name}_sum{{{lab}}} {row.get('sum', 0.0)}")
+                lines.append(f"{name}_count{{{lab}}} {row.get('count', 0)}")
+            else:
+                val = row.get("value", 0.0)
+                v = int(val) if float(val).is_integer() else val
+                lines.append(f"{name}{{{lab}}} {v}")
+    return "\n".join(lines) + "\n"
+
+
 _DEFAULT = MetricsRegistry()
 
 

@@ -331,6 +331,14 @@ def run_grid_pass(
 
         responses: list[str] = []
         engine = fabric if fabric is not None else runner
+        # The fabric (not the runner) takes the journal identity of each
+        # queued trial: in multi-host mode, trials other hosts decode come
+        # back through the shipped journals keyed by (pass, trial id).
+        fab_extra = (
+            {"trial_keys": [tids[i] for i in remaining],
+             "pass_name": pass_key}
+            if fabric is not None else {}
+        )
         if remaining:
             try:
                 responses = engine.generate_grid_scheduled(
@@ -358,6 +366,7 @@ def run_grid_pass(
                     stop_event=stop_event,
                     faults=faults,
                     trace=trace,
+                    **fab_extra,
                 )
             except SweepInterrupted:
                 # Graceful stop: everything harvested so far has already
@@ -368,6 +377,21 @@ def run_grid_pass(
                 if journal is not None:
                     journal.flush()
                 raise
+
+        # Trials decoded on OTHER hosts never passed through this host's
+        # result_cb/grade pool, but the decoding host graded and journaled
+        # them before its lease completed — pick those verdicts up from the
+        # merged journals instead of re-grading locally.
+        remote_graded: dict[str, dict] = {}
+        if (journal is not None
+                and getattr(fabric, "coordinator_url", None) is not None):
+            remote_graded = journal.graded(pass_key)
+
+        def assembled(i: int) -> dict:
+            r = make_result(i, responses[pos_of[i]])
+            if tids[i] in remote_graded:
+                r["evaluations"] = remote_graded[tids[i]]
+            return r
 
         if grade_pool is None:
             out = []
@@ -380,7 +404,7 @@ def run_grid_pass(
                 elif i in streamed:
                     out.append(streamed[i])
                 else:
-                    out.append(make_result(i, responses[pos_of[i]]))
+                    out.append(assembled(i))
             if journal is not None:
                 # One fsync per pass: by the time any cell's results.json can
                 # be written, every decoded record of this pass is durable —
@@ -409,7 +433,7 @@ def run_grid_pass(
             elif i in streamed:
                 out.append(streamed[i])
             else:
-                out.append(make_result(i, responses[pos_of[i]]))
+                out.append(assembled(i))
         if journal is not None:
             journal.flush()  # pass complete & durable before any cell save
         return out

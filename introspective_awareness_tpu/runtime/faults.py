@@ -22,6 +22,14 @@ strike —
   mid-sweep while the others run clean (the kill-one-worker resume
   drill). Without it a plan afflicts every replica through shared
   counters.
+- ``kill_host=h``: scope the whole plan to multi-host fabric host h
+  (the whole-host preemption drill: every host process reads the same
+  ``IAT_FAULTS``, only host h's fabric arms the plan). Composes with
+  ``kill_replica`` to target one replica ON one host.
+- ``kill_coordinator_after=n``: the RPC coordinator hard-exits
+  (``os._exit``) while handling its n-th request — the
+  coordinator-crash drill; worker hosts ride the outage on client
+  retries and a restarted coordinator resumes from its WAL.
 
 Plans parse from a spec string (``--inject-faults`` /  the ``IAT_FAULTS``
 env var): comma-separated ``key=value`` pairs, bare keys meaning 1 —
@@ -78,17 +86,24 @@ class FaultPlan:
     # Fabric targeting: None = every replica; an int scopes the plan to
     # that replica id (SweepFabric passes other replicas faults=None).
     kill_replica: Optional[int] = None
+    # Multi-host targeting: None = every host; an int scopes the plan to
+    # that fabric host id (the whole-host preemption drill).
+    kill_host: Optional[int] = None
+    # Coordinator targeting: hard-exit while handling the n-th RPC/HTTP
+    # request (only the coordinator process ticks the "rpc" point).
+    kill_coordinator_after: int = 0
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
     )
     _chunks: int = field(default=0, repr=False, compare=False)
     _admissions: int = field(default=0, repr=False, compare=False)
+    _rpcs: int = field(default=0, repr=False, compare=False)
     _judge_fails: int = field(default=0, repr=False, compare=False)
 
     _KEYS = (
         "crash_after_chunks", "crash_on_admission",
         "judge_timeout", "judge_rate_limit", "judge_5xx", "torn_tail",
-        "kill_replica",
+        "kill_replica", "kill_host", "kill_coordinator_after",
     )
 
     @classmethod
@@ -105,7 +120,16 @@ class FaultPlan:
                 raise ValueError(
                     f"unknown fault {key!r} (expected one of {cls._KEYS})"
                 )
-            kw[key] = int(value) if value else 1
+            if key in kw:
+                raise ValueError(
+                    f"fault {key!r} given twice in spec {spec!r}"
+                )
+            try:
+                kw[key] = int(value) if value else 1
+            except ValueError:
+                raise ValueError(
+                    f"fault {key!r} needs an integer value, got {value!r}"
+                ) from None
         return cls(**kw)
 
     @classmethod
@@ -118,9 +142,18 @@ class FaultPlan:
     def tick(self, point: str) -> None:
         """Advance the named counter; raise :class:`InjectedCrash` when its
         configured threshold is reached. Points: ``"chunk"`` (one processed
-        decode chunk), ``"admission"`` (one refill/admit dispatch)."""
+        decode chunk), ``"admission"`` (one refill/admit dispatch),
+        ``"rpc"`` (one coordinator request handled)."""
         with self._lock:
-            if point == "chunk":
+            if point == "rpc":
+                self._rpcs += 1
+                if self.kill_coordinator_after and (
+                    self._rpcs == self.kill_coordinator_after
+                ):
+                    raise InjectedCrash(
+                        f"injected coordinator kill on rpc {self._rpcs}"
+                    )
+            elif point == "chunk":
                 self._chunks += 1
                 if self.crash_after_chunks and (
                     self._chunks == self.crash_after_chunks

@@ -130,6 +130,15 @@ class TrialJournal:
         )
         self._lock = threading.Lock()
         self._unsynced = 0
+        # Durability degradation flag: an fsync that fails (disk full, I/O
+        # error) must not kill the sweep mid-decode — records still reach
+        # the OS via flush — but the loss of the durability guarantee is
+        # surfaced through /healthz and this counter.
+        self.fsync_failed = False
+        self._m_fsync_failures = default_registry().counter(
+            "iat_journal_fsync_failures_total",
+            "journal fsync calls that raised (durability degraded)",
+        )
         # Replayed state: pass_key -> {trial key -> payload}. Trial keys are
         # opaque (str or int) and pass through JSON unchanged.
         self._decoded: dict[str, dict] = {}
@@ -259,13 +268,20 @@ class TrialJournal:
 
     # -- append --------------------------------------------------------------
 
+    def _fsync_locked(self) -> None:
+        try:
+            os.fsync(self._f.fileno())
+        except OSError:
+            self.fsync_failed = True
+            self._m_fsync_failures.inc()
+        self._unsynced = 0
+
     def _append(self, obj: dict) -> None:
         self._f.write(_frame(obj))
         self._f.flush()
         self._unsynced += 1
         if self._unsynced >= self.fsync_every:
-            os.fsync(self._f.fileno())
-            self._unsynced = 0
+            self._fsync_locked()
         self._m_records.inc(kind=obj.get("ev", "unknown"))
 
     def record_decoded(self, pass_key: str, idx, result: dict) -> None:
@@ -329,14 +345,13 @@ class TrialJournal:
 
     def _sync_locked(self) -> None:
         self._f.flush()
-        os.fsync(self._f.fileno())
-        self._unsynced = 0
+        self._fsync_locked()
 
     def close(self) -> None:
         with self._lock:
             if not self._f.closed:
                 self._f.flush()
-                os.fsync(self._f.fileno())
+                self._fsync_locked()
                 self._f.close()
 
     # -- replayed-state accessors -------------------------------------------

@@ -1,0 +1,339 @@
+"""Multi-host fabric smoke: coordinator-run sweeps across two host
+processes, host-level preemption, and a coordinator kill+restart.
+
+The CI lane for the multi-host contract (README "Sweep fabric — spanning
+hosts"), runnable anywhere the tier-1 suite runs — hosts are separate
+CPU processes sharing one output dir, the coordinator is a third:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/multihost_smoke.py
+
+Phase 1 (per temperature 0.0 and 1.0) — kill BOTH hosts mid-sweep:
+host 1 dies after 2 chunks (``crash_after_chunks=2,kill_host=1``), the
+survivor steals its expired leases and then dies itself at chunk 5.
+A fresh coordinator + both hosts resume from the shipped/spooled
+journals; every cell must come out byte-identical to the single-host
+reference. Greedy AND sampled, because trial PRNG streams are keyed by
+global queue index — host count and steal pattern must not matter.
+
+Phase 2 — kill the coordinator mid-protocol (``kill_coordinator_after``
+via ``IAT_FAULTS``; hard ``os._exit(41)``): the harness restarts it on
+the SAME port with the SAME WAL while both hosts ride the outage on
+client retries. The run must finish clean, match the reference, and the
+replayed WAL must show every pass's trial indices completed exactly
+once — nothing lost, nothing double-executed across the restart.
+
+Exit code 0 = all phases hold. Any assertion prints what diverged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+)
+
+HOST_TIMEOUT_S = 900
+
+
+def _argv(out_dir: Path, temperature: float, extra=()) -> list[str]:
+    # One cell (vs fabric_smoke's four): every run here pays a fresh
+    # process + jit compile, so the grid stays as small as the contract
+    # allows while still spanning multiple scheduler passes and chunks.
+    return [
+        "--models", "tiny",
+        "--concepts", "Dust", "Trees",
+        "--n-baseline", "5",
+        "--layer-sweep", "0.5",
+        "--strength-sweep", "4.0",
+        "--n-trials", "4",
+        "--max-tokens", "8",
+        "--batch-size", "16",
+        "--temperature", str(temperature),
+        "--output-dir", str(out_dir),
+        "--dtype", "float32",
+        "--judge-backend", "none",
+        "--scheduler", "continuous",
+        "--obs-ledger", "off",
+        *extra,
+    ]
+
+
+def _cells(out_dir: Path) -> dict:
+    return {
+        p.parent.name: json.loads(p.read_text())
+        for p in sorted((out_dir / "tiny").glob("layer_*/results.json"))
+    }
+
+
+# -- process management --------------------------------------------------------
+
+
+def _spawn_coordinator(base: Path, wal: Path, port: int = 0,
+                       lease_ttl: float = 3.0,
+                       faults: str | None = None):
+    """Start a coordinator subprocess; return (proc, url, port)."""
+    port_file = base / f"coord_port_{time.monotonic_ns()}"
+    env = dict(os.environ)
+    env.pop("IAT_FAULTS", None)
+    if faults:
+        env["IAT_FAULTS"] = faults
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "introspective_awareness_tpu.fabric"
+         ".coordinator", "--port", str(port),
+         "--port-file", str(port_file), "--wal", str(wal),
+         "--lease-ttl", str(lease_ttl)],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + 30
+    while not port_file.exists():
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"coordinator died before serving (rc={proc.returncode})")
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise AssertionError("coordinator never wrote its port file")
+        time.sleep(0.05)
+    got = int(port_file.read_text())
+    return proc, f"http://127.0.0.1:{got}", got
+
+
+def _spawn_host(base: Path, out_dir: Path, temperature: float, host: int,
+                url: str, extra=()):
+    log = base / f"host{host}.{time.monotonic_ns()}.log"
+    argv = _argv(out_dir, temperature, [
+        "--fabric-coordinator", url,
+        "--fabric-host", str(host),
+        "--fabric-hosts", "2",
+        "--fabric-heartbeat", "0.5",
+        "--fabric-spool", str(out_dir / f"spool{host}"),
+        *extra,
+    ])
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "introspective_awareness_tpu.cli", *argv],
+        cwd=REPO, env=dict(os.environ),
+        stdout=open(log, "wb"), stderr=subprocess.STDOUT,
+    )
+    proc._iat_log = log  # type: ignore[attr-defined]
+    return proc
+
+
+def _wait(procs, timeout_s: float = HOST_TIMEOUT_S) -> list[int]:
+    deadline = time.monotonic() + timeout_s
+    codes = []
+    for p in procs:
+        try:
+            codes.append(p.wait(timeout=max(1.0, deadline
+                                            - time.monotonic())))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            codes.append(-9)
+    return codes
+
+
+def _tail(proc, n: int = 30) -> str:
+    try:
+        lines = Path(proc._iat_log).read_text(errors="replace").splitlines()
+        return "\n".join(lines[-n:])
+    except OSError:
+        return "<no log>"
+
+
+def _check_identical(ref: dict, got: dict, what: str) -> None:
+    diverged = [c for c in ref if got.get(c) != ref[c]]
+    assert not diverged, f"cells diverged {what}: {diverged}"
+
+
+# -- phase 1: both hosts die, merged resume ------------------------------------
+
+
+def phase_kill_hosts(base: Path, temperature: float) -> dict:
+    from introspective_awareness_tpu.cli.sweep import main
+
+    tag = f"t{temperature:g}"
+    print(f"[phase 1/{tag}] single-host reference")
+    assert main(_argv(base / f"ref_{tag}", temperature)) == 0
+    ref = _cells(base / f"ref_{tag}")
+    assert ref, "reference sweep produced no cells"
+
+    print(f"[phase 1/{tag}] 2 hosts, kill host 1 @chunk 2, "
+          f"host 0 @chunk 5")
+    out = base / f"kill_{tag}"
+    coord, url, _ = _spawn_coordinator(base, base / f"wal_{tag}.jsonl")
+    try:
+        hosts = [
+            _spawn_host(base, out, temperature, 0, url, [
+                "--inject-faults", "crash_after_chunks=5,kill_host=0"]),
+            _spawn_host(base, out, temperature, 1, url, [
+                "--inject-faults", "crash_after_chunks=2,kill_host=1"]),
+        ]
+        codes = _wait(hosts)
+        for h, rc in zip(hosts, codes):
+            assert rc not in (0, -9), (
+                f"injected crash never fired (rc={rc}):\n{_tail(h)}")
+    finally:
+        coord.kill()
+        coord.wait()
+
+    spooled = list((out / "spool0").glob("*.jsonl")) \
+        + list((out / "spool1").glob("*.jsonl"))
+    shipped = list((out / "tiny").glob("trial_journal.host*.jsonl"))
+    assert shipped or spooled, "no journals survived the host kills"
+
+    print(f"[phase 1/{tag}] resume: fresh coordinator, both hosts")
+    coord, url, _ = _spawn_coordinator(
+        base, base / f"wal_{tag}_resume.jsonl")
+    try:
+        hosts = [_spawn_host(base, out, temperature, h, url)
+                 for h in (0, 1)]
+        codes = _wait(hosts)
+        for h, rc in zip(hosts, codes):
+            assert rc == 0, f"resume host failed (rc={rc}):\n{_tail(h)}"
+    finally:
+        coord.kill()
+        coord.wait()
+
+    _check_identical(ref, _cells(out), f"after 2-host kill+resume ({tag})")
+    print(f"[phase 1/{tag}] OK: {len(ref)} cells identical after "
+          f"host-kill + merged resume")
+    return ref
+
+
+# -- phase 2: coordinator dies mid-protocol ------------------------------------
+
+
+def _wal_replay(wal: Path) -> dict:
+    """Per-pass completion ledger from the WAL: join completes to their
+    acquires by lease_id (stale completes are logged no-ops), requeue
+    fail/expire. Returns {pass_id: {"n_items", "completed": [...]}}."""
+    from introspective_awareness_tpu.runtime.journal import _parse_line
+
+    passes: dict[str, dict] = {}
+    starts = 0
+    for ln in wal.read_bytes().splitlines(keepends=True):
+        rec = _parse_line(ln)
+        if rec is None:
+            continue
+        ev = rec.get("ev")
+        if ev == "coord_start":
+            starts += 1
+            continue
+        if ev == "pass_open":
+            passes[rec["pass"]] = {"n_items": rec["n_items"],
+                                   "leases": {}, "completed": []}
+            continue
+        p = passes.get(rec.get("pass"))
+        if p is None:
+            continue
+        if ev == "acquire":
+            d = rec["lease"]
+            p["leases"][d["lease_id"]] = list(d["indices"])
+        elif ev == "complete":
+            indices = p["leases"].pop(rec["lease_id"], None)
+            if indices is not None:
+                p["completed"].extend(indices)
+        elif ev in ("fail", "expire"):
+            p["leases"].pop(rec["lease_id"], None)
+    return {"starts": starts, "passes": passes}
+
+
+def phase_kill_coordinator(base: Path, ref: dict,
+                           temperature: float = 1.0) -> dict:
+    out = base / "coordkill"
+    wal = base / "wal_coordkill.jsonl"
+    print("[phase 2] coordinator hard-killed after 40 requests, "
+          "restarted on the same port + WAL")
+    coord, url, port = _spawn_coordinator(
+        base, wal, faults="kill_coordinator_after=40")
+    restarts = 0
+    try:
+        hosts = [_spawn_host(base, out, temperature, h, url)
+                 for h in (0, 1)]
+        deadline = time.monotonic() + HOST_TIMEOUT_S
+        while any(h.poll() is None for h in hosts):
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"hosts wedged riding the coordinator outage:\n"
+                    f"{_tail(hosts[0])}\n{_tail(hosts[1])}")
+            if coord.poll() is not None:
+                assert coord.returncode == 41, (
+                    f"coordinator died with rc={coord.returncode}, "
+                    f"expected the injected os._exit(41)")
+                # Same port, same WAL, faults cleared: recovery resumes
+                # outstanding leases and the idempotency cache.
+                coord, url, _ = _spawn_coordinator(base, wal, port=port)
+                restarts += 1
+            time.sleep(0.1)
+        codes = [h.wait() for h in hosts]
+        for h, rc in zip(hosts, codes):
+            assert rc == 0, (
+                f"host did not survive the coordinator restart "
+                f"(rc={rc}):\n{_tail(h)}")
+    finally:
+        coord.kill()
+        coord.wait()
+
+    assert restarts >= 1, "fault never fired — coordinator was not killed"
+    _check_identical(ref, _cells(out), "across the coordinator restart")
+
+    ledger = _wal_replay(wal)
+    # Recovery APPENDS to the original WAL stream (one coord_start ever);
+    # the restart itself is proven by rc=41 + the restarts counter above.
+    assert ledger["starts"] == 1, (
+        f"recovered WAL should keep its single coord_start, "
+        f"got {ledger['starts']}")
+    for pid, p in ledger["passes"].items():
+        want = list(range(p["n_items"]))
+        got = sorted(p["completed"])
+        assert got == want, (
+            f"pass {pid}: completed indices {got} != exactly-once "
+            f"coverage of {p['n_items']} trials")
+    print(f"[phase 2] OK: {len(ref)} cells identical, "
+          f"{len(ledger['passes'])} passes each completed exactly once "
+          f"across {restarts} coordinator restart(s)")
+    return {"restarts": restarts, "passes": len(ledger["passes"])}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch dir for debugging")
+    ns = ap.parse_args()
+
+    td = tempfile.mkdtemp(prefix="multihost_smoke_")
+    base = Path(td)
+    try:
+        phase_kill_hosts(base, 0.0)
+        ref = phase_kill_hosts(base, 1.0)
+        coord = phase_kill_coordinator(base, ref)
+    finally:
+        if ns.keep:
+            print(f"scratch kept at {base}")
+        else:
+            import shutil
+            shutil.rmtree(base, ignore_errors=True)
+
+    print(json.dumps({
+        "multihost_smoke": "ok",
+        "cells": len(ref),
+        "coordinator_restarts": coord["restarts"],
+        "passes_exactly_once": coord["passes"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,471 @@
+"""Multi-host fabric control plane: RPC coordinator, WAL crash recovery,
+idempotent retries, lease-TTL preemption recovery, and the fault knobs.
+
+The contract under test (README "Sweep fabric — multi-host"):
+
+- worker hosts drain ONE pass through ``RemoteQueue`` with the exact
+  lease semantics of the in-process queue — every index completes
+  exactly once, fleet-wide;
+- a retried RPC (response lost after the server processed it) replays
+  the SAME lease from the idempotency cache instead of double-issuing;
+- a coordinator kill + restart from the CRC-framed WAL resumes leases —
+  nothing is lost, nothing re-issued — and a torn WAL tail is dropped
+  while mid-file corruption refuses recovery;
+- a host that stops heartbeating has its leases TTL-requeued so
+  survivors pick the work up (blocking ``acquire`` waits for exactly
+  this);
+- client backoff is capped at the ceiling and the circuit breaker
+  degrades a worker host to drain-and-exit (``SweepInterrupted``), never
+  a fleet crash.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from introspective_awareness_tpu.fabric import (
+    CoordinatorServer,
+    CoordinatorService,
+    CoordinatorUnavailable,
+    RemoteQueue,
+    RpcClient,
+    RpcFault,
+)
+from introspective_awareness_tpu.obs.registry import MetricsRegistry
+from introspective_awareness_tpu.runtime.faults import FaultPlan, InjectedCrash
+from introspective_awareness_tpu.runtime.journal import (
+    JournalError,
+    SweepInterrupted,
+)
+
+
+def _client(url, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("backoff_base_s", 0.01)
+    return RpcClient(url, **kw)
+
+
+@pytest.fixture()
+def served():
+    service = CoordinatorService(wal_path=None, lease_ttl_s=30.0)
+    server = CoordinatorServer(service, port=0).start()
+    try:
+        yield service, server
+    finally:
+        server.stop()
+
+
+# --- end-to-end drain over real HTTP -----------------------------------------
+
+
+class TestRemoteQueueDrain:
+    def test_two_hosts_drain_every_index_exactly_once(self, served):
+        service, server = served
+        c0 = _client(server.url, client_id="h0")
+        c1 = _client(server.url, client_id="h1")
+        for c in (c0, c1):
+            c.call("open_pass", {"pass_id": "p1", "n_items": 10,
+                                 "n_workers": 2, "lease_size": 3})
+        q0 = RemoteQueue(c0, "p1", worker_base=0, poll_interval_s=0.02)
+        q1 = RemoteQueue(c1, "p1", worker_base=1, poll_interval_s=0.02)
+        seen: list[int] = []
+        lock = threading.Lock()
+
+        def drain(q):
+            while True:
+                lease = q.acquire(0)
+                if lease is None:
+                    return
+                with lock:
+                    seen.extend(lease.indices)
+                q.complete(lease)
+
+        threads = [threading.Thread(target=drain, args=(q,))
+                   for q in (q0, q1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert sorted(seen) == list(range(10))
+        status = q0.status()
+        assert status["done"]
+        assert status["stats"]["completed_trials"] == 10
+
+    def test_acquire_blocks_until_globally_complete(self, served):
+        """A host whose partition is dry must NOT leave while another
+        host still holds a lease — TTL expiry could requeue that work."""
+        service, server = served
+        c = _client(server.url, client_id="h")
+        c.call("open_pass", {"pass_id": "p1", "n_items": 2,
+                             "n_workers": 2, "lease_size": 2})
+        # Worker 0 claims its own partition, then steals the rest: it now
+        # holds every index while worker 1 sees an empty queue.
+        held = [c.call("acquire", {"pass_id": "p1", "worker": 0})["lease"]
+                for _ in range(2)]
+        assert sorted(i for l in held for i in l["indices"]) == [0, 1]
+
+        q = RemoteQueue(c, "p1", worker_base=1, poll_interval_s=0.02)
+        got: list = []
+        t = threading.Thread(target=lambda: got.append(q.acquire(0)))
+        t.start()
+        time.sleep(0.15)
+        assert t.is_alive(), "acquire returned while leases were in flight"
+        for lease in held:
+            c.call("complete", {"pass_id": "p1",
+                                "lease_id": lease["lease_id"]})
+        t.join(timeout=10)
+        assert got == [None]  # pass globally complete → clean drain exit
+
+    def test_open_pass_config_divergence_is_fatal(self, served):
+        _, server = served
+        c = _client(server.url)
+        c.call("open_pass", {"pass_id": "p1", "n_items": 4,
+                             "n_workers": 2, "lease_size": 1})
+        # Same id, same shape → idempotent join.
+        assert c.call("open_pass", {"pass_id": "p1", "n_items": 4,
+                                    "n_workers": 2, "lease_size": 1}) \
+            == {"created": False}
+        with pytest.raises(RpcFault, match="diverge"):
+            c.call("open_pass", {"pass_id": "p1", "n_items": 5,
+                                 "n_workers": 2, "lease_size": 1})
+
+
+# --- idempotency --------------------------------------------------------------
+
+
+class TestIdempotentRetries:
+    def test_lost_response_replays_same_lease_no_double_issue(self, served):
+        """Server processes the acquire but the response is lost: the
+        retry (same req_id) must return the SAME lease, leaving exactly
+        one lease outstanding."""
+        service, server = served
+        c = _client(server.url, client_id="h0")
+        c.call("open_pass", {"pass_id": "p1", "n_items": 6,
+                             "n_workers": 1, "lease_size": 2})
+        real_send = c._send
+        dropped = {"n": 0}
+
+        def lossy_send(payload):
+            doc = real_send(payload)
+            msg = json.loads(payload.decode())
+            if msg["method"] == "acquire" and dropped["n"] == 0:
+                dropped["n"] += 1
+                raise socket.timeout("response lost on the wire")
+            return doc
+
+        c._send = lossy_send
+        lease = c.call("acquire", {"pass_id": "p1", "worker": 0})["lease"]
+        assert dropped["n"] == 1  # the first response really was dropped
+        assert lease["indices"] == [0, 1]
+        p = service._passes["p1"]
+        assert set(p.leases) == {lease["lease_id"]}
+        assert p.queue.remaining() == 4  # not 2: no second lease issued
+
+    def test_duplicate_complete_is_a_recorded_noop(self, served):
+        service, _ = served
+        service.handle("open_pass", {"pass_id": "p1", "n_items": 2,
+                                     "n_workers": 1, "lease_size": 2})
+        lease = service.handle("acquire", {"pass_id": "p1", "worker": 0},
+                               req_id="a:1")["lease"]
+        params = {"pass_id": "p1", "lease_id": lease["lease_id"]}
+        # Retried RPC: same req_id replays the cached response.
+        assert service.handle("complete", params, req_id="c:1") \
+            == {"completed": True}
+        assert service.handle("complete", params, req_id="c:1") \
+            == {"completed": True}
+        # A genuinely new duplicate (stale holder racing TTL expiry) is
+        # acknowledged but changes nothing.
+        assert service.handle("complete", params, req_id="c:2") \
+            == {"completed": False}
+        st = service.handle("status", {"pass_id": "p1"})
+        assert st["stats"]["completed_trials"] == 2  # counted once
+
+
+# --- client backoff / breaker -------------------------------------------------
+
+
+class TestClientResilience:
+    def test_backoff_is_capped_at_the_ceiling(self):
+        delays: list[float] = []
+        c = RpcClient(
+            "http://127.0.0.1:1", max_retries=6, backoff_base_s=1.0,
+            backoff_ceiling_s=2.0, breaker_threshold=100,
+            sleep=delays.append, registry=MetricsRegistry(),
+        )
+        c._send = lambda payload: (_ for _ in ()).throw(
+            ConnectionError("down"))
+        with pytest.raises(CoordinatorUnavailable):
+            c.call("ping")
+        assert len(delays) == 6
+        # Exponential up to the ceiling; jitter adds at most 25%.
+        assert all(d <= 2.0 * 1.25 for d in delays)
+        assert delays[-1] >= 2.0  # the cap was actually reached
+
+    def test_breaker_opens_then_fails_fast_without_network(self):
+        attempts = {"n": 0}
+
+        def dead_send(payload):
+            attempts["n"] += 1
+            raise ConnectionError("down")
+
+        c = RpcClient(
+            "http://127.0.0.1:1", max_retries=0, breaker_threshold=1,
+            breaker_cooldown_s=60.0, sleep=lambda s: None,
+            registry=MetricsRegistry(),
+        )
+        c._send = dead_send
+        with pytest.raises(CoordinatorUnavailable):
+            c.call("ping")
+        n_after_first = attempts["n"]
+        with pytest.raises(CoordinatorUnavailable):
+            c.call("ping")
+        assert attempts["n"] == n_after_first  # open breaker: no attempt
+
+    def test_remote_queue_surfaces_breaker_as_graceful_drain(self):
+        c = RpcClient(
+            "http://127.0.0.1:1", max_retries=0, breaker_threshold=1,
+            sleep=lambda s: None, registry=MetricsRegistry(),
+        )
+        c._send = lambda payload: (_ for _ in ()).throw(
+            ConnectionError("down"))
+        q = RemoteQueue(c, "p1")
+        with pytest.raises(SweepInterrupted, match="draining host"):
+            q.acquire(0)
+
+    def test_nonretryable_fault_surfaces_without_retries(self, served):
+        _, server = served
+        sleeps: list[float] = []
+        c = _client(server.url, sleep=sleeps.append)
+        with pytest.raises(RpcFault, match="unknown pass"):
+            c.call("acquire", {"pass_id": "nope", "worker": 0})
+        assert sleeps == []  # semantic error: retrying cannot help
+
+
+# --- WAL crash recovery -------------------------------------------------------
+
+
+class TestWalRecovery:
+    def _drain_all(self, service, pass_id, worker=0):
+        out = []
+        while True:
+            doc = service.handle("acquire",
+                                 {"pass_id": pass_id, "worker": worker})
+            if doc["lease"] is None:
+                return out
+            out.extend(doc["lease"]["indices"])
+            service.handle(
+                "complete",
+                {"pass_id": pass_id, "lease_id": doc["lease"]["lease_id"]},
+            )
+
+    def test_restart_resumes_leases_and_never_double_issues(self, tmp_path):
+        wal = tmp_path / "wal.jsonl"
+        s1 = CoordinatorService(wal_path=wal, lease_ttl_s=30.0)
+        s1.handle("open_pass", {"pass_id": "p1", "n_items": 8,
+                                "n_workers": 2, "lease_size": 3})
+        a = s1.handle("acquire", {"pass_id": "p1", "worker": 0},
+                      req_id="h0:1")["lease"]
+        b = s1.handle("acquire", {"pass_id": "p1", "worker": 1},
+                      req_id="h1:1")["lease"]
+        s1.handle("complete", {"pass_id": "p1", "lease_id": a["lease_id"]},
+                  req_id="h0:2")
+        s1.close()  # hard stop: no shutdown protocol beyond the WAL
+
+        s2 = CoordinatorService(wal_path=wal, lease_ttl_s=30.0)
+        p = s2._passes["p1"]
+        # The uncompleted lease survived the restart, still outstanding.
+        assert set(p.leases) == {b["lease_id"]}
+        assert p.leases[b["lease_id"]].indices == b["indices"]
+        # Retried RPCs from before the crash replay from the recovered
+        # idempotency cache — bit-for-bit the same answers.
+        assert s2.handle("acquire", {"pass_id": "p1", "worker": 0},
+                         req_id="h0:1")["lease"] == a
+        assert s2.handle("complete",
+                         {"pass_id": "p1", "lease_id": a["lease_id"]},
+                         req_id="h0:2") == {"completed": True}
+        # Fresh leases never overlap in-flight or completed work.
+        rest = self._drain_all(s2, "p1")
+        s2.handle("complete", {"pass_id": "p1", "lease_id": b["lease_id"]})
+        assert sorted(rest + a["indices"] + b["indices"]) == list(range(8))
+        st = s2.handle("status", {"pass_id": "p1"})
+        assert st["done"] and st["stats"]["completed_trials"] == 8
+        s2.close()
+
+    def test_torn_tail_is_dropped_midfile_corruption_refuses(self, tmp_path):
+        wal = tmp_path / "wal.jsonl"
+        s1 = CoordinatorService(wal_path=wal, lease_ttl_s=None)
+        s1.handle("open_pass", {"pass_id": "p1", "n_items": 4,
+                                "n_workers": 1, "lease_size": 2})
+        s1.handle("acquire", {"pass_id": "p1", "worker": 0}, req_id="r1")
+        s1.close()
+
+        # Kill mid-append: the last record is sheared mid-line. Recovery
+        # drops it — the response never went out, the client will retry.
+        whole = wal.read_bytes()
+        wal.write_bytes(whole[:-10])
+        s2 = CoordinatorService(wal_path=wal, lease_ttl_s=None)
+        assert s2._passes["p1"].leases == {}  # torn acquire dropped
+        assert s2._passes["p1"].queue.remaining() == 4
+        s2.close()
+
+        # Corruption BEFORE the tail is not a torn append — refuse.
+        lines = whole.splitlines(keepends=True)
+        lines[1] = b"xxxxxxxx " + lines[1][9:]
+        wal.write_bytes(b"".join(lines))
+        with pytest.raises(JournalError, match="corrupt"):
+            CoordinatorService(wal_path=wal, lease_ttl_s=None)
+
+    def test_not_a_wal_refuses(self, tmp_path):
+        wal = tmp_path / "wal.jsonl"
+        from introspective_awareness_tpu.runtime.journal import _frame
+        wal.write_bytes(_frame({"ev": "decoded"}))
+        with pytest.raises(JournalError, match="coord_start"):
+            CoordinatorService(wal_path=wal)
+
+
+# --- lease TTL over the wire --------------------------------------------------
+
+
+class TestHostPreemption:
+    def test_dead_host_leases_requeue_to_survivor(self, tmp_path):
+        clock = {"t": 0.0}
+        service = CoordinatorService(
+            wal_path=tmp_path / "wal.jsonl", lease_ttl_s=10.0,
+            clock=lambda: clock["t"],
+        )
+        service.handle("open_pass", {"pass_id": "p1", "n_items": 4,
+                                     "n_workers": 2, "lease_size": 2})
+        dead = service.handle("acquire", {"pass_id": "p1", "worker": 0},
+                              req_id="h0:1")["lease"]
+        assert dead["indices"] == [0, 1]
+        # Host 1 heartbeats; host 0 went silent past the TTL.
+        clock["t"] = 5.0
+        service.handle("heartbeat", {"host": "1", "workers": [1]})
+        clock["t"] = 11.0
+        survivor = service.handle("acquire",
+                                  {"pass_id": "p1", "worker": 1})["lease"]
+        assert survivor["indices"] == [2, 3]  # own partition head first
+        requeued = service.handle("acquire",
+                                  {"pass_id": "p1", "worker": 1})["lease"]
+        # The dead host's indices come back in queue order, stolen.
+        assert requeued["indices"] == [0, 1]
+        st = service.handle("status", {"pass_id": "p1"})
+        assert st["stats"]["expired_leases"] == 1
+        # The expiry hit the WAL: a restarted coordinator agrees.
+        service.close()
+        s2 = CoordinatorService(wal_path=tmp_path / "wal.jsonl",
+                                lease_ttl_s=10.0)
+        assert s2._passes["p1"].queue.stats.expired_leases == 1
+        assert dead["lease_id"] not in s2._passes["p1"].leases
+        s2.close()
+
+    def test_heartbeat_renews_only_named_workers(self):
+        clock = {"t": 0.0}
+        service = CoordinatorService(lease_ttl_s=10.0,
+                                     clock=lambda: clock["t"])
+        service.handle("open_pass", {"pass_id": "p1", "n_items": 4,
+                                     "n_workers": 2, "lease_size": 2})
+        service.handle("acquire", {"pass_id": "p1", "worker": 0})
+        service.handle("acquire", {"pass_id": "p1", "worker": 1})
+        clock["t"] = 8.0
+        assert service.handle("heartbeat",
+                              {"host": "1", "workers": [1]})["renewed"] == 1
+        clock["t"] = 12.0  # worker 0's original deadline passed
+        st = service.handle("status", {"pass_id": "p1"})
+        assert st["stats"]["expired_leases"] == 1
+        assert st["outstanding"] == 1  # worker 1 renewed, still alive
+
+
+# --- coordinator restart over HTTP (same port, same WAL) ----------------------
+
+
+class TestCoordinatorRestartOverHttp:
+    def test_client_rides_the_outage_on_retries(self, tmp_path):
+        wal = tmp_path / "wal.jsonl"
+        s1 = CoordinatorService(wal_path=wal, lease_ttl_s=30.0)
+        srv1 = CoordinatorServer(s1, port=0).start()
+        port = srv1.port
+        c = _client(f"http://127.0.0.1:{port}", max_retries=8,
+                    client_id="h0")
+        c.call("open_pass", {"pass_id": "p1", "n_items": 4,
+                             "n_workers": 1, "lease_size": 2})
+        lease = c.call("acquire", {"pass_id": "p1", "worker": 0})["lease"]
+        srv1.stop()  # coordinator dies holding our lease
+
+        done = {}
+
+        def finish():
+            done["r"] = c.call(
+                "complete",
+                {"pass_id": "p1", "lease_id": lease["lease_id"]},
+            )
+
+        t = threading.Thread(target=finish)
+        t.start()  # retries against a dead port while we restart
+        time.sleep(0.1)
+        s2 = CoordinatorService(wal_path=wal, lease_ttl_s=30.0)
+        srv2 = CoordinatorServer(s2, port=port).start()
+        t.join(timeout=30)
+        assert done["r"] == {"completed": True}
+        st = c.call("status", {"pass_id": "p1"})
+        assert st["stats"]["completed_trials"] == 2
+        srv2.stop()
+
+
+# --- fault-plan parsing & the rpc injection point (satellite) -----------------
+
+
+class TestFaultKnobs:
+    def test_kill_host_and_coordinator_knobs_parse(self):
+        p = FaultPlan.from_spec(
+            "kill_host=1,kill_coordinator_after=7,crash_after_chunks=2"
+        )
+        assert p.kill_host == 1
+        assert p.kill_coordinator_after == 7
+        assert p.crash_after_chunks == 2
+
+    def test_unknown_key_rejected_with_candidates(self):
+        with pytest.raises(ValueError, match="unknown fault 'kill_hots'"):
+            FaultPlan.from_spec("kill_hots=1")
+
+    def test_duplicate_key_rejected(self):
+        with pytest.raises(ValueError, match="given twice"):
+            FaultPlan.from_spec("kill_host=1,kill_host=2")
+
+    def test_non_integer_value_rejected(self):
+        with pytest.raises(ValueError, match="needs an integer"):
+            FaultPlan.from_spec("kill_coordinator_after=soon")
+
+    def test_bare_key_means_one(self):
+        assert FaultPlan.from_spec("torn_tail").torn_tail == 1
+
+    def test_rpc_tick_fires_on_the_nth_request(self):
+        p = FaultPlan.from_spec("kill_coordinator_after=3")
+        p.tick("rpc")
+        p.tick("rpc")
+        with pytest.raises(InjectedCrash, match="rpc 3"):
+            p.tick("rpc")
+        p.tick("rpc")  # one-shot: later requests pass (counter moved on)
+
+    def test_kill_host_scopes_fabric_plans(self):
+        # SweepFabric._faults_for semantics without building a fabric:
+        # the plan is inert on every host but the target.
+        from introspective_awareness_tpu.fabric.fabric import SweepFabric
+
+        plan = FaultPlan.from_spec("crash_after_chunks=1,kill_host=1")
+
+        class _F:  # bare shim carrying host_id for the unbound method
+            pass
+
+        f = _F()
+        f.host_id = 0
+        assert SweepFabric._faults_for(f, plan, 0) is None
+        f.host_id = 1
+        assert SweepFabric._faults_for(f, plan, 0) is plan
+        # kill_replica still scopes within the targeted host.
+        plan2 = FaultPlan.from_spec("crash_after_chunks=1,kill_replica=1")
+        assert SweepFabric._faults_for(f, plan2, 0) is None
+        assert SweepFabric._faults_for(f, plan2, 1) is plan2

@@ -77,6 +77,72 @@ def test_queue_explicit_partitions_must_cover_exactly_once():
         PartitionedTrialQueue(4, 2, partitions=[[0, 1], [2]])
 
 
+class TestLeaseTtl:
+    """The wedged-worker leak: a holder that never calls complete/fail
+    must not strand its lease forever once ``lease_ttl_s`` is set."""
+
+    def test_expired_lease_requeues_to_home_front_in_queue_order(self):
+        now = {"t": 0.0}
+        q = PartitionedTrialQueue(
+            6, 2, lease_size=2, lease_ttl_s=10.0, clock=lambda: now["t"]
+        )
+        a = q.acquire(0)
+        assert a.indices == [0, 1]
+        now["t"] = 10.0  # deadline reached — the holder is presumed dead
+        b = q.acquire(1)
+        # Replica 1 gets its own head first; the expiry already fired.
+        assert b.indices == [3, 4]
+        assert q.stats.expired_leases == 1
+        # The expired indices sit at the FRONT of partition 0 in queue
+        # order, exactly like a failed lease.
+        c = q.acquire(0)
+        assert c.indices == [0, 1] and not c.stolen
+        # The stale holder's late complete is a no-op (lease id is gone).
+        q.complete(a)
+        assert q.stats.completed_trials == 0
+        q.complete(b)
+        q.complete(c)
+
+    def test_touch_renews_deadline(self):
+        now = {"t": 0.0}
+        q = PartitionedTrialQueue(
+            4, 2, lease_size=2, lease_ttl_s=5.0, clock=lambda: now["t"]
+        )
+        a = q.acquire(0)
+        now["t"] = 4.0
+        assert q.touch(0) == 1  # heartbeat renews only replica 0's lease
+        now["t"] = 8.0  # original deadline long past; renewed one is not
+        assert q.outstanding() == 1
+        assert q.stats.expired_leases == 0
+        now["t"] = 9.0  # renewed deadline (4+5) reached
+        assert q.outstanding() == 0
+        assert q.stats.expired_leases == 1
+        assert a.lease_id not in q.outstanding_ids()
+
+    def test_remaining_and_outstanding_observe_expiry(self):
+        now = {"t": 0.0}
+        q = PartitionedTrialQueue(
+            2, 1, lease_size=2, lease_ttl_s=1.0, clock=lambda: now["t"]
+        )
+        q.acquire(0)
+        assert q.remaining() == 0 and q.outstanding() == 1
+        now["t"] = 1.5
+        assert q.remaining() == 2  # requeued, visible without an acquire
+        assert q.outstanding() == 0
+
+    def test_ttl_must_be_positive(self):
+        with pytest.raises(ValueError):
+            PartitionedTrialQueue(2, 1, lease_ttl_s=0.0)
+        with pytest.raises(ValueError):
+            PartitionedTrialQueue(2, 1, lease_ttl_s=-1.0)
+
+    def test_no_ttl_means_no_expiry(self):
+        q = PartitionedTrialQueue(2, 1, lease_size=2)
+        q.acquire(0)
+        assert q.touch(0) == 0
+        assert q.outstanding() == 1  # forever — single-host semantics
+
+
 # --- registry reserved label budget ------------------------------------------
 
 
@@ -339,6 +405,125 @@ def test_fabric_journal_set_merges_by_identity(tmp_path):
     assert set(merged.graded("p")) == {"b"}
     assert merged.gauges.recovered_trials == 2
     merged.discard()
+
+
+# --- multi-host: two fabrics, one coordinator --------------------------------
+
+
+def _host_fabric(h, server, base, cfg_sig, tmp_path, make_runner, **fab_kw):
+    js = FabricJournalSet(
+        base, cfg_sig, n_replicas=1, host_id=h,
+        spool_dir=tmp_path / f"spool{h}",
+    )
+    fab = SweepFabric(
+        [make_runner()], registry=MetricsRegistry(), journals=js,
+        coordinator_url=server.url, host_id=h, n_hosts=2,
+        heartbeat_s=0.2,
+    )
+    return js, fab
+
+
+def test_multihost_two_fabrics_bit_identical(tmp_path, grid, make_runner):
+    """Two 'hosts' (separate SweepFabrics against one coordinator) split
+    one pass; each fills its remotely-decoded trials from the other's
+    shipped journals and BOTH return the full single-host reference."""
+    from introspective_awareness_tpu.fabric import (
+        CoordinatorServer,
+        CoordinatorService,
+    )
+    from introspective_awareness_tpu.protocol.trials import run_grid_pass
+
+    runner, tasks, lookup = grid
+    ref = run_grid_pass(runner, "injection", tasks, lookup, **_kw(1.0))
+
+    server = CoordinatorServer(
+        CoordinatorService(lease_ttl_s=30.0), port=0
+    ).start()
+    base = tmp_path / "shared" / "trial_journal.jsonl"
+    cfg_sig = {"grid": "multihost-identity"}
+    outs: dict = {}
+    errs: list = []
+
+    def host(h):
+        try:
+            js, fab = _host_fabric(
+                h, server, base, cfg_sig, tmp_path, make_runner
+            )
+            outs[h] = run_grid_pass(
+                runner, "injection", tasks, lookup, fabric=fab,
+                journal=js, pass_key="p", **_kw(1.0),
+            )
+            js.flush()
+            js.close()
+        except BaseException as e:  # noqa: BLE001 — reraise on the main thread
+            errs.append(e)
+
+    threads = [threading.Thread(target=host, args=(h,)) for h in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    server.stop()
+    assert not errs, errs
+    assert outs[0] == ref
+    assert outs[1] == ref
+
+
+def test_multihost_kill_host_survivor_finishes_pass(
+    tmp_path, grid, make_runner
+):
+    """kill_host=1 crashes only host 1's fabric; its failed lease requeues
+    through the coordinator and host 0 finishes the WHOLE pass, output
+    bit-identical to the reference."""
+    from introspective_awareness_tpu.fabric import (
+        CoordinatorServer,
+        CoordinatorService,
+    )
+    from introspective_awareness_tpu.protocol.trials import run_grid_pass
+
+    runner, tasks, lookup = grid
+    ref = run_grid_pass(runner, "injection", tasks, lookup, **_kw(1.0))
+
+    server = CoordinatorServer(
+        CoordinatorService(lease_ttl_s=30.0), port=0
+    ).start()
+    base = tmp_path / "shared" / "trial_journal.jsonl"
+    cfg_sig = {"grid": "multihost-kill"}
+    plan = FaultPlan(crash_after_chunks=1, kill_host=1)
+    outs: dict = {}
+    errs: dict = {}
+
+    def host(h):
+        try:
+            js, fab = _host_fabric(
+                h, server, base, cfg_sig, tmp_path, make_runner
+            )
+            outs[h] = run_grid_pass(
+                runner, "injection", tasks, lookup, fabric=fab,
+                journal=js, pass_key="p", faults=plan, **_kw(1.0),
+            )
+            js.flush()
+            js.close()
+        except BaseException as e:  # noqa: BLE001 — asserted below
+            errs[h] = e
+
+    threads = [threading.Thread(target=host, args=(h,)) for h in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    server.stop()
+    assert isinstance(errs.get(1), InjectedCrash)  # the targeted host died
+    assert 0 not in errs
+    assert outs[0] == ref  # the survivor completed every trial
+
+
+def test_multihost_fabric_requires_shipping_journals(make_runner):
+    with pytest.raises(ValueError, match="shipping"):
+        SweepFabric(
+            [make_runner()], registry=MetricsRegistry(),
+            coordinator_url="http://127.0.0.1:1",
+        )
 
 
 # --- CLI: one end-to-end 2-replica identity run ------------------------------

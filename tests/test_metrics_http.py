@@ -146,6 +146,70 @@ class TestMetricsServer:
         with pytest.raises(RuntimeError):
             MetricsServer().port
 
+    def test_healthz_degrades_to_503_with_reasons(self):
+        from introspective_awareness_tpu.obs import HealthState
+
+        health = HealthState()
+        breaker_open = {"v": False}
+        health.add_probe(
+            "judge_breaker",
+            lambda: "circuit breaker open" if breaker_open["v"] else None,
+        )
+        fsync_failed = {"v": False}
+        health.add_probe(
+            "journal_fsync",
+            lambda: "fsync failing" if fsync_failed["v"] else None,
+        )
+        with MetricsServer(registry=MetricsRegistry(), port=0,
+                           health=health) as srv:
+            code, _, body = _get(srv.url + "/healthz")
+            assert code == 200 and body == b"ok\n"
+
+            breaker_open["v"] = True
+            fsync_failed["v"] = True
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.url + "/healthz")
+            assert ei.value.code == 503
+            body = ei.value.read().decode()
+            assert "degraded" in body
+            assert "judge_breaker: circuit breaker open" in body
+            assert "journal_fsync: fsync failing" in body
+
+            # Back to healthy once the conditions clear.
+            breaker_open["v"] = False
+            fsync_failed["v"] = False
+            code, _, body = _get(srv.url + "/healthz")
+            assert code == 200
+
+    def test_healthz_probe_exception_reads_degraded(self):
+        from introspective_awareness_tpu.obs import HealthState
+
+        health = HealthState()
+        health.add_probe("boom", lambda: 1 / 0)
+        with MetricsServer(registry=MetricsRegistry(), port=0,
+                           health=health) as srv:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.url + "/healthz")
+            assert ei.value.code == 503
+            assert "boom" in ei.value.read().decode()
+
+    def test_registry_snapshot_endpoint_feeds_federation(self):
+        from introspective_awareness_tpu.obs import render_federated
+
+        reg = MetricsRegistry()
+        reg.counter("iat_trials_total", "trials").inc(9)
+        reg.gauge("iat_occupancy").set(0.75)
+        with MetricsServer(registry=reg, port=0) as srv:
+            code, ctype, body = _get(srv.url + "/registry")
+            assert code == 200 and ctype == "application/json"
+            snap = json.loads(body)
+        # The coordinator's /metrics merges per-host snapshots with a
+        # host label prepended to every series.
+        text = render_federated({"0": snap, "1": snap})
+        assert 'iat_trials_total{host="0"} 9' in text
+        assert 'iat_trials_total{host="1"} 9' in text
+        assert 'iat_occupancy{host="0"} 0.75' in text
+
 
 class TestLiveSweep:
     """The acceptance-criteria path: a real CPU-smoke sweep with
