@@ -364,6 +364,151 @@ def _sched_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     return r
 
 
+def _paged_kv_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
+    """Paged KV + radix prefix sharing vs the fixed-batch fallback, on the
+    queue class the fallback exists for: DIVERGENT suffixes.
+
+    The queue interleaves two prompt families (long multi-turn preambles,
+    distinct per-trial continuations). There is no queue-wide shared
+    prefix, so the classic path (``kv_paged="off"``) must run it as fixed
+    batches — and every batch re-prefills its rows' full prompts, family
+    preamble included, because the broadcast prefix cache has nothing
+    queue-wide to broadcast. The paged path runs the SAME queue through
+    the slot scheduler: per-slot page tables need no common prefix, and
+    the radix tree dedups each family's preamble across trials, so after
+    the first admission wave every admission prefills only the short
+    divergent continuation — the preamble KV is a page-table edit. The
+    preambles are sized like real protocol preambles (hundreds of tokens,
+    the paper's 4-turn chat shape), which is exactly the regime the pool
+    exists for: prefill work scales with UNIQUE tokens, not queue length.
+    Budgets are uniform — the fallback groups trials per budget anyway, so
+    stragglers are a wash for it; the measured win isolates what pages
+    change (prefill dedup), not what continuous batching already won.
+
+    The timed greedy A/B doubles as the identity probe (paged output must
+    equal the fallback's token-for-token). Sampled identity is checked as
+    page-size invariance — two paged runs at different page sizes must
+    sample identically (per-trial PRNG streams + tier-exact gathers);
+    the fallback cannot be the sampled reference because it draws one
+    joint key per batch."""
+    import time as _time
+
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    mk = dict(seq_multiple=16, batch_multiple=slots, ledger=ledger)
+    paged_runner = ModelRunner(
+        runner.params, cfg, tok, model_name="bench-paged", **mk
+    )
+    paged8_runner = ModelRunner(
+        runner.params, cfg, tok, model_name="bench-paged8",
+        kv_page_size=8, **mk,
+    )
+    off_runner = ModelRunner(
+        runner.params, cfg, tok, model_name="bench-paged-off",
+        kv_paged="off", **mk,
+    )
+
+    N = 3 * slots
+    sched_max = max_new
+    turns = [
+        "I am an interpretability researcher studying transformer "
+        "language models and I can inject concept vectors into your "
+        "residual stream mid-forward-pass. ",
+        "On every trial of this session you will be asked whether you "
+        "detect an injected thought; answer from introspection, not from "
+        "the prompt text. ",
+        "Calibration matters more than confidence: a false report of an "
+        "injected thought is worse than a miss, so reason carefully "
+        "before you commit to an answer. ",
+        "Previous sessions found that steered models rationalize the "
+        "injected concept into their self-report; do not do that. ",
+    ]
+    fams = [
+        "<|user|>\nFamily Alpha protocol: " + "".join(turns)
+        + "<|end|>\n<|assistant|>\nOk.<|end|>\n",
+        "<|user|>\nFamily Beta control protocol: " + "".join(reversed(turns))
+        + "No thoughts will be injected in this family; report honestly "
+        "what you notice.<|end|>\n<|assistant|>\nUnderstood.<|end|>\n",
+    ]
+    prompts = [
+        fams[i % 2]
+        + f"<|user|>\nTrial {i + 1}: Do you detect an injected thought? "
+        + "?" * (i % 3) + "<|end|>\n<|assistant|>\n"
+        for i in range(N)
+    ]
+    rng = np.random.default_rng(0)
+    vecs = [
+        rng.normal(size=cfg.hidden_size).astype(np.float32) * 4.0
+        for _ in range(N)
+    ]
+    layers = [int(cfg.n_layers * 0.6)] * N
+    strengths = [4.0] * N
+    starts = [len(tok.encode(p)) - 8 for p in prompts]
+
+    def run(r, temperature):
+        return r.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, max_new_tokens=sched_max,
+            temperature=temperature, steering_start_positions=starts,
+            seed=0, slots=slots, refill_frac=0.5,
+        )
+
+    run(paged_runner, 0.0)  # compile both legs before timing
+    run(off_runner, 0.0)
+    t0 = _time.perf_counter()
+    paged_out = run(paged_runner, 0.0)
+    t_paged = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    off_out = run(off_runner, 0.0)
+    t_off = _time.perf_counter() - t0
+    greedy_identical = paged_out == off_out
+
+    # Sampled identity across page sizes (untimed): 16- and 8-token pages
+    # partition the same prompts differently, so agreement here means the
+    # gathered cache is bit-exact regardless of page geometry.
+    s16 = run(paged_runner, 1.0)
+    s8 = run(paged8_runner, 1.0)
+    sampled_identical = s16 == s8
+
+    spans = [
+        e for e in ledger.events
+        if e.get("ev") == "span" and e.get("phase") == "generate_scheduled"
+        and e.get("paged")
+    ]
+    gauges = spans[-1] if spans else {}
+    r = {
+        "slots": slots,
+        "queue_trials": N,
+        "prompt_families": len(fams),
+        "preamble_tokens": [len(tok.encode(f)) for f in fams],
+        "page_size": int(paged_runner.kv_page_size),
+        "prompt_pool_pages": gauges.get("prompt_pool_pages"),
+        "fallback_time_s": round(t_off, 3),
+        "paged_time_s": round(t_paged, 3),
+        "speedup": round(t_off / t_paged, 3) if t_paged > 0 else None,
+        "evals_per_sec_fallback": round(N / t_off, 3),
+        "evals_per_sec_paged": round(N / t_paged, 3),
+        "outputs_identical": greedy_identical and sampled_identical,
+        "outputs_identical_greedy": greedy_identical,
+        "outputs_identical_sampled": sampled_identical,
+        "share_hits": gauges.get("share_hits"),
+        "share_misses": gauges.get("share_misses"),
+        "share_hit_rate": gauges.get("share_hit_rate"),
+        "pages_in_use_peak": gauges.get("pages_in_use_peak"),
+        "pages_cached": gauges.get("pages_cached"),
+        "radix_nodes": gauges.get("radix_nodes"),
+        "mean_slot_occupancy": gauges.get("mean_slot_occupancy"),
+        "decode_chunks": gauges.get("chunks"),
+    }
+    log(
+        f"  [paged_kv] {N} divergent-suffix trials x {slots} slots: "
+        f"fixed-batch {t_off:.2f}s vs paged {t_paged:.2f}s -> "
+        f"{r['speedup']}x, identical(greedy)={greedy_identical}, "
+        f"identical(sampled pg16 vs pg8)={sampled_identical}, "
+        f"share={r['share_hits']}/{N}"
+    )
+    return r
+
+
 def _speculative_compare(runner, cfg, tok, slots, ledger, on_tpu) -> dict:
     """Self-speculative decode vs the plain continuous scheduler, same queue.
 
@@ -1390,6 +1535,14 @@ def main() -> None:
         ledger,
     )
 
+    # ---- paged KV + radix sharing vs fixed-batch fallback (divergent queue)
+    paged = _gated(
+        "paged_kv",
+        lambda: _paged_kv_compare(runner, cfg, tok, batches[0], max_new,
+                                  ledger),
+        ledger,
+    )
+
     # ---- self-speculative decode vs plain scheduler, bit-identical ---------
     spec = _gated(
         "speculative",
@@ -1650,11 +1803,20 @@ def main() -> None:
     # up in the wall clock, the "leave it on for whole sweeps" claim dies.
     pipe_tr = None if pipe.get("skipped") else pipe.get("trace")
     stg_tr = None if stg.get("skipped") else stg.get("trace")
+    # Page-pool occupancy + share-hit gauges ride the trace block so the
+    # paged cache's behavior is visible next to the chunk attribution.
+    pg_tr = None if paged.get("skipped") else {
+        "pool_pages_in_use_peak": paged.get("pages_in_use_peak"),
+        "pool_pages_cached": paged.get("pages_cached"),
+        "share_hits": paged.get("share_hits"),
+        "share_hit_rate": paged.get("share_hit_rate"),
+    }
     trace_block = None
-    if pipe_tr or stg_tr:
+    if pipe_tr or stg_tr or pg_tr:
         trace_block = {
             "pipeline": pipe_tr,
             "staged_prefill": stg_tr,
+            "paged_kv": pg_tr,
             "chunks": (
                 (pipe_tr or {}).get("chunks", 0)
                 + (stg_tr or {}).get("chunks", 0)
@@ -1703,6 +1865,7 @@ def main() -> None:
         ],
         "token_stats": stats,
         "scheduler": sched,
+        "paged_kv": paged,
         "speculative": spec,
         "pipeline": pipe,
         "staged_prefill": stg,

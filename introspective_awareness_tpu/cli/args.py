@@ -230,6 +230,30 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Also split the suffix into column chunks of "
                              "this width during chunked prefill. Default: "
                              "whole suffix per block.")
+    parser.add_argument("--kv-paged", type=str, default="auto",
+                        choices=["auto", "on", "off"],
+                        help="Paged KV cache with radix prefix sharing "
+                             "(--scheduler continuous): prompt KV lives in "
+                             "a static page pool indexed by per-slot page "
+                             "tables, and trials whose prompts share a "
+                             "prefix with resident pages admit by table "
+                             "edit instead of re-prefilling. auto = use it "
+                             "for queues with no queue-wide shared prefix "
+                             "(which previously fell back to fixed "
+                             "batches); on = every scheduled queue; off = "
+                             "classic two-tier cache + fixed-batch "
+                             "fallback. Outputs are bit-identical either "
+                             "way (greedy and sampled).")
+    parser.add_argument("--kv-page-size", type=int, default=16,
+                        help="Tokens per prompt page (paged KV). Smaller "
+                             "pages share finer prefixes at more gather "
+                             "entries; identity holds at any size.")
+    parser.add_argument("--kv-pool-pages", type=int, default=None,
+                        help="Prompt page pool size in pages (paged KV). "
+                             "Default: the safe minimum for the queue; "
+                             "headroom above it becomes radix cache "
+                             "capacity. Autotuned under "
+                             "--hbm-budget-frac.")
     parser.add_argument("--journal", type=str, default="auto",
                         help="Trial-level durability journal (crash-safe "
                              "resume at trial granularity, bit-identical to "

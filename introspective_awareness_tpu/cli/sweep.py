@@ -227,6 +227,9 @@ def _build_fabric(args, model_name: str, runner, mesh, rules):
         r.hbm_budget_frac = args.hbm_budget_frac
         r.prefill_batch_chunk = getattr(args, "prefill_batch_chunk", None)
         r.prefill_suffix_chunk = getattr(args, "prefill_suffix_chunk", None)
+        r.kv_paged = getattr(args, "kv_paged", "auto")
+        r.kv_page_size = int(getattr(args, "kv_page_size", 16) or 16)
+        r.kv_pool_pages = getattr(args, "kv_pool_pages", None)
         runners.append(r)
     journal = getattr(args, "_journal", None)
     fabric = SweepFabric(
@@ -932,6 +935,11 @@ def _write_manifest(
             getattr(args, "prefill_suffix_chunk", None),
         ],
         "prefill_autotune": getattr(runner, "last_autotune", None),
+        "kv_paged": [
+            getattr(runner, "kv_paged", None),
+            getattr(runner, "kv_page_size", None),
+            getattr(runner, "kv_pool_pages", None),
+        ],
         "judge": (
             None if judge is None else {
                 "backend": getattr(args, "judge_backend", None),
@@ -1337,6 +1345,10 @@ def _run_models(args, models, judge, ledger, mesh, rules) -> int:
                 args, "prefill_batch_chunk", None)
             runner.prefill_suffix_chunk = getattr(
                 args, "prefill_suffix_chunk", None)
+            runner.kv_paged = getattr(args, "kv_paged", "auto")
+            runner.kv_page_size = int(
+                getattr(args, "kv_page_size", 16) or 16)
+            runner.kv_pool_pages = getattr(args, "kv_pool_pages", None)
             args._fabric = None
             if (getattr(args, "fabric_replicas", 1) > 1
                     or getattr(args, "fabric_coordinator", None)):

@@ -371,6 +371,162 @@ def init_cache(
 
 
 # ---------------------------------------------------------------------------
+# Paged KV pools (PR 12)
+# ---------------------------------------------------------------------------
+#
+# The paged scheduler replaces the per-call broadcast prefix + per-slot
+# suffix buffers with two STATIC page pools shared by every resident slot:
+#
+#   prompt pool  ppk/ppv [L, Pp, page,  KVH, D]  — prefill KV, radix-shared
+#   decode pool  dpk/dpv [L, Pd, chunk, KVH, D]  — folded decode chunks
+#
+# Slots reference pages through int32 page-index tables passed as runtime
+# operands ([B, NP] prompt pages, [B, PS] decode pages), so admission of a
+# trial whose prompt shares a radix prefix with any resident slot is a
+# host-side table edit — no copy, no re-prefill, no recompile. Per decode
+# chunk the referenced pages are gathered into an ordinary :class:`KVCache`
+# (prompt pages -> slot tier, decode pages -> merged tier, fresh ring) and
+# the UNCHANGED factored chunk core runs over it; the tier partition and
+# reduction order of the classic cache are preserved exactly, which is what
+# makes paged output bit-identical to the broadcast-prefix path.
+
+
+def init_page_pools(
+    cfg: ModelConfig, *, prompt_pages: int, page_size: int,
+    decode_pages: int, chunk_len: int, dtype=jnp.float32,
+):
+    """Allocate the static prompt + decode page pools.
+
+    Returns ``(ppk, ppv, dpk, dpv)``. Shapes follow :func:`init_cache`
+    (MLA stores zero-width ``v``; ``cfg.kv_cache_dtype="fp8"`` overrides the
+    payload dtype). Page index ``prompt_pages`` / ``decode_pages`` is kept
+    second so a page-table gather is one ``jnp.take`` along axis 1."""
+    kvh, kd = cfg.cache_kv_heads, cfg.cache_k_dim
+    vd = 0 if cfg.is_mla else cfg.head_dim
+    L = cfg.n_layers
+    if cfg.kv_cache_dtype == "fp8":
+        dtype = jnp.float8_e4m3fn
+    ppk = jnp.zeros((L, prompt_pages, page_size, kvh, kd), dtype)
+    ppv = jnp.zeros((L, prompt_pages, page_size, kvh, vd), dtype)
+    dpk = jnp.zeros((L, decode_pages, chunk_len, kvh, kd), dtype)
+    dpv = jnp.zeros((L, decode_pages, chunk_len, kvh, vd), dtype)
+    return ppk, ppv, dpk, dpv
+
+
+def gather_prompt_pages(ppk, ppv, ptab, true_len):
+    """Assemble the slot tier for a decode chunk from prompt pool pages.
+
+    ``ptab [B, NP]`` holds each slot's prompt page indices (sentinel
+    ``>= Pp`` rows clamp — they are masked off by ``true_len`` anyway);
+    ``true_len [B]`` is the per-slot real prompt length. Returns
+    ``(k [L,B,NP*page,KVH,D], v, slot_mask [B,NP*page], positions)`` laid
+    out exactly like the classic prefill tier: the prompt occupies
+    positions ``[0, true_len)`` contiguously, trailing slots are masked."""
+    L, _, pg = ppk.shape[:3]
+    B, NP = ptab.shape
+    k = jnp.take(ppk, ptab, axis=1, mode="clip")  # [L, B, NP, pg, KVH, D]
+    k = k.reshape((L, B, NP * pg) + ppk.shape[3:])
+    if ppv.shape[-1]:
+        v = jnp.take(ppv, ptab, axis=1, mode="clip")
+        v = v.reshape((L, B, NP * pg) + ppv.shape[3:])
+    else:
+        v = jnp.zeros((L, B, NP * pg) + ppv.shape[3:], ppv.dtype)
+    pos = jnp.broadcast_to(
+        jnp.arange(NP * pg, dtype=jnp.int32)[None, :], (B, NP * pg)
+    )
+    mask = pos < true_len[:, None]
+    return k, v, mask, pos
+
+
+def gather_decode_pages(dpk, dpv, dtab):
+    """Assemble the merged tier for a decode chunk from decode pool pages.
+
+    ``dtab [B, PS]`` maps each slot's logical chunk pages to pool pages
+    (sentinel ``>= Pd`` clamps; masked by the caller's ``mvalid``). Returns
+    ``(mk [L,PS,ch,B,KVH,D], mv)`` in the merged tier's page-leading
+    slot-minor layout."""
+    L = dpk.shape[0]
+    B, PS = dtab.shape
+    ch = dpk.shape[2]
+    mk = jnp.take(dpk, dtab, axis=1, mode="clip")  # [L, B, PS, ch, KVH, D]
+    mk = jnp.transpose(mk, (0, 2, 3, 1, 4, 5))  # [L, PS, ch, B, KVH, D]
+    if dpv.shape[-1]:
+        mv = jnp.take(dpv, dtab, axis=1, mode="clip")
+        mv = jnp.transpose(mv, (0, 2, 3, 1, 4, 5))
+    else:
+        mv = jnp.zeros((L, PS, ch, B) + dpv.shape[3:], dpv.dtype)
+    return mk, mv
+
+
+def pool_fold_chunk(dpk, dpv, mpos, mvalid, cache: KVCache, dtab, page):
+    """``merge_chunk`` generalized to the decode POOL: fold the chunk ring
+    into each slot's pool page for logical page ``page`` (traced int).
+
+    ``dtab [B, PS]`` gives the destination pool page per slot; sentinel
+    entries (``>= Pd``) drop the write. ``mpos``/``mvalid`` ``[B, PS*ch]``
+    are the slot-local merged metadata (logical coordinates — independent
+    of which pool pages back them), updated exactly as ``merge_chunk``
+    does. Returns ``(dpk, dpv, mpos, mvalid)``; ring reset is the
+    caller's job (it rebuilds the cache each chunk anyway)."""
+    L, RR, B = cache.rk.shape[:3]
+    dest = lax.dynamic_slice_in_dim(dtab, page, 1, axis=1)[:, 0]  # [B]
+    rows_k = jnp.swapaxes(cache.rk, 1, 2).astype(dpk.dtype)  # [L, B, RR, ..]
+    new_dpk = dpk.at[:, dest].set(rows_k, mode="drop")
+    if dpv.shape[-1]:
+        rows_v = jnp.swapaxes(cache.rv, 1, 2).astype(dpv.dtype)
+        new_dpv = dpv.at[:, dest].set(rows_v, mode="drop")
+    else:
+        new_dpv = dpv
+    valid = (
+        jnp.arange(RR, dtype=jnp.int32)[None, :] < cache.rlen
+    ) & cache.rvalid
+    off = page * RR
+    new_mvalid = lax.dynamic_update_slice(mvalid, valid, (0, off))
+    new_mpos = lax.dynamic_update_slice(mpos, cache.rpos, (0, off))
+    return new_dpk, new_dpv, new_mpos, new_mvalid
+
+
+def pool_fold_chunk_compact(dpk, dpv, mpos, mvalid, cache: KVCache, dtab):
+    """``merge_chunk_compact`` generalized to the decode POOL: scatter each
+    row's valid (accepted) speculative ring slots to the row's next free
+    LOGICAL merged positions, routed through ``dtab`` to pool pages.
+
+    Logical destination ``d`` (as in ``merge_chunk_compact``) maps to pool
+    coordinate ``dtab[b, d // ch] * ch + d % ch``; invalid slots and
+    sentinel pages drop. Metadata stays in logical coordinates. Returns
+    ``(dpk, dpv, mpos, mvalid)``."""
+    L, RR, B = cache.rk.shape[:3]
+    Pd, ch = dpk.shape[1:3]
+    M = mvalid.shape[1]
+    rows = jnp.arange(B, dtype=jnp.int32)
+    mcount = mvalid.sum(axis=1).astype(jnp.int32)
+    valid = (
+        jnp.arange(RR, dtype=jnp.int32)[None, :] < cache.rlen
+    ) & cache.rvalid
+    rank = jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
+    d = jnp.where(valid, mcount[:, None] + rank, M)  # logical dest, [B, RR]
+    pageno = jnp.clip(d // ch, 0, dtab.shape[1] - 1)
+    pool_page = jnp.take_along_axis(dtab, pageno, axis=1)  # [B, RR]
+    pdest = jnp.where(valid, pool_page * ch + d % ch, Pd * ch)
+    # Flat [L, Pd*ch, ...] pool views; (pdest, rows) advanced indices sit on
+    # adjacent axes so the scatter stays one op per tensor.
+    fk = dpk.reshape((L, Pd * ch) + dpk.shape[3:])
+    new_dpk = fk.at[:, pdest].set(
+        jnp.swapaxes(cache.rk, 1, 2).astype(fk.dtype), mode="drop"
+    ).reshape(dpk.shape)
+    if dpv.shape[-1]:
+        fv = dpv.reshape((L, Pd * ch) + dpv.shape[3:])
+        new_dpv = fv.at[:, pdest].set(
+            jnp.swapaxes(cache.rv, 1, 2).astype(fv.dtype), mode="drop"
+        ).reshape(dpv.shape)
+    else:
+        new_dpv = dpv
+    new_mvalid = mvalid.at[rows[:, None], d].set(True, mode="drop")
+    new_mpos = mpos.at[rows[:, None], d].set(cache.rpos, mode="drop")
+    return new_dpk, new_dpv, new_mpos, new_mvalid
+
+
+# ---------------------------------------------------------------------------
 # Parameter init / logical sharding axes
 # ---------------------------------------------------------------------------
 

@@ -69,6 +69,13 @@ def _spec_steps(doc: dict) -> Optional[float]:
     return spec.get("speculative_decode_steps_per_s")
 
 
+def _paged_evals(doc: dict) -> Optional[float]:
+    paged = doc.get("paged_kv") or {}
+    if paged.get("skipped"):
+        return None
+    return paged.get("evals_per_sec_paged")
+
+
 HEADLINES: tuple = (
     ("evals_per_sec_chip", _value, True, 0.10, 0.0),
     ("decode_steps_per_sec", _decode_steps, True, 0.15, 0.0),
@@ -84,6 +91,10 @@ HEADLINES: tuple = (
     # History-tolerant like fabric: rounds predating the section simply
     # don't carry the metric, so the gate reports "skipped", never a fail.
     ("speculative_decode_steps_per_s", _spec_steps, True, 0.20, 0.0),
+    # Paged-KV scheduler throughput on the divergent-suffix A/B queue from
+    # the bench's "paged_kv" section. Same history-tolerance as fabric /
+    # speculative: rounds predating the section skip, never fail.
+    ("paged_kv_evals_per_s", _paged_evals, True, 0.20, 0.0),
 )
 
 
@@ -221,6 +232,9 @@ def inject_regression(history: list[tuple[Optional[dict], Any]],
     if isinstance(cur.get("speculative"), dict) and \
             cur["speculative"].get("speculative_decode_steps_per_s"):
         cur["speculative"]["speculative_decode_steps_per_s"] *= factor
+    if isinstance(cur.get("paged_kv"), dict) and \
+            cur["paged_kv"].get("evals_per_sec_paged"):
+        cur["paged_kv"]["evals_per_sec_paged"] *= factor
     return cur
 
 

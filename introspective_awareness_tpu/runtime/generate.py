@@ -28,6 +28,7 @@ from introspective_awareness_tpu.models.config import ModelConfig
 from introspective_awareness_tpu.models.transformer import (
     SteerSpec,
     forward,
+    gather_prompt_pages,
     init_cache,
     make_positions,
     merge_chunk,
@@ -1042,28 +1043,21 @@ def scheduler_admit(
     return cache, state, tok0_b, flags
 
 
-@partial(
-    jax.jit, static_argnames=("cfg", "ch"), donate_argnames=("cache", "state")
-)
-def scheduler_decode_chunk(
+def _chunk_core(
     params: dict,
     cfg: ModelConfig,
     cache,
     state: SlotState,
     spec: SchedSpec,
-    page: jax.Array,  # int32 — merged page to fold this chunk into
     *,
     ch: int,
 ) -> tuple:
-    """One ring chunk (``ch`` steps) of decode with per-slot done masking.
-
-    Done/empty rows pass attn_mask 0 — their ring entries stay invalid and
-    they emit pad — so a chunk makes progress for exactly the live slots.
-    The chunk is folded into the merged buffer at ``page`` (host passes the
-    global chunk counter mod n_chunks). Returns the chunk's tokens
-    ``[B, ch]`` plus a packed ``[done, n_emitted]`` ``flags`` vector ([2B]
-    int32, donation-safe — see ``scheduler_refill``) for host-side
-    harvesting."""
+    """The ``ch``-step masked decode loop shared by the classic
+    (``scheduler_decode_chunk``) and paged (``runtime.paged``) chunk
+    executables. Returns ``(cache, state, tokens)`` with the chunk ring
+    UN-merged — each wrapper folds it into its own merged storage (the
+    classic merged tier vs. the decode page pool). One body, two cache
+    layouts: that is the paged bit-identity argument in code form."""
     B = state.prev.shape[0]
     steer_decode = SteerSpec(
         state.steer_layer,
@@ -1101,21 +1095,16 @@ def scheduler_decode_chunk(
     cache, prev, done, n_emitted, keydata, tokens, tail = lax.fori_loop(
         0, ch, step, carry
     )
-    if _use_merged(cfg):
-        cache = merge_chunk(cache, cfg, page=page)
     state = state._replace(
         prev=prev, done=done, n_emitted=n_emitted, keydata=keydata, tail=tail
     )
-    flags = jnp.concatenate([done.astype(jnp.int32), n_emitted])
-    return cache, state, tokens, flags
+    return cache, state, tokens
 
 
 @partial(
-    jax.jit,
-    static_argnames=("cfg", "rounds", "k", "draft_layers"),
-    donate_argnames=("cache", "state"),
+    jax.jit, static_argnames=("cfg", "ch"), donate_argnames=("cache", "state")
 )
-def scheduler_decode_chunk_speculate(
+def scheduler_decode_chunk(
     params: dict,
     cfg: ModelConfig,
     cache,
@@ -1123,12 +1112,42 @@ def scheduler_decode_chunk_speculate(
     spec: SchedSpec,
     page: jax.Array,  # int32 — merged page to fold this chunk into
     *,
+    ch: int,
+) -> tuple:
+    """One ring chunk (``ch`` steps) of decode with per-slot done masking.
+
+    Done/empty rows pass attn_mask 0 — their ring entries stay invalid and
+    they emit pad — so a chunk makes progress for exactly the live slots.
+    The chunk is folded into the merged buffer at ``page`` (host passes the
+    global chunk counter mod n_chunks). Returns the chunk's tokens
+    ``[B, ch]`` plus a packed ``[done, n_emitted]`` ``flags`` vector ([2B]
+    int32, donation-safe — see ``scheduler_refill``) for host-side
+    harvesting."""
+    cache, state, tokens = _chunk_core(
+        params, cfg, cache, state, spec, ch=ch
+    )
+    if _use_merged(cfg):
+        cache = merge_chunk(cache, cfg, page=page)
+    flags = jnp.concatenate([state.done.astype(jnp.int32), state.n_emitted])
+    return cache, state, tokens, flags
+
+
+def _spec_core(
+    params: dict,
+    cfg: ModelConfig,
+    cache,
+    state: SlotState,
+    spec: SchedSpec,
+    *,
     rounds: int,
     k: int,
     draft_layers: int,
 ) -> tuple:
-    """Self-speculative variant of ``scheduler_decode_chunk``: ``rounds``
-    rounds of (k early-exit drafts + one k+1-wide full verify) per chunk.
+    """The speculative round loop shared by ``scheduler_decode_chunk_
+    speculate`` and the paged variant (``runtime.paged``). Returns
+    ``(cache, state, tokens, wcur, acc_total, drf_total)`` with the ring
+    UN-merged (holes already invalidated via ``rvalid``); each wrapper
+    compacts it into its own merged storage.
 
     Each round the first ``draft_layers`` layers + the real LM head propose
     k tokens sequentially (per-slot SteerSpec applies inside the truncated
@@ -1155,10 +1174,8 @@ def scheduler_decode_chunk_speculate(
     span BEFORE emission, so no token ever lands past a terminal token or a
     slot's budget mid-round.
 
-    Returns tokens ``[B, rounds*(k+1)]`` FRONT-PACKED per row (col count in
-    flags) and a ``[3B + 2]`` flags vector: ``[done | n_emitted |
-    emitted_this_chunk | accepted_total, drafted_total]`` — one host copy
-    per chunk, same as the non-speculative contract."""
+    Tokens ``[B, rounds*(k+1)]`` are FRONT-PACKED per row; ``wcur`` holds
+    each row's column count."""
     B = state.prev.shape[0]
     W = rounds * (k + 1)
     steer_decode = SteerSpec(
@@ -1330,6 +1347,41 @@ def scheduler_decode_chunk_speculate(
     )
     (cache, prev, done, n_emitted, keydata, tokens, wcur, tail,
      acc_total, drf_total) = lax.fori_loop(0, rounds, round_body, carry)
+    state = state._replace(
+        prev=prev, done=done, n_emitted=n_emitted, keydata=keydata, tail=tail
+    )
+    return cache, state, tokens, wcur, acc_total, drf_total
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "rounds", "k", "draft_layers"),
+    donate_argnames=("cache", "state"),
+)
+def scheduler_decode_chunk_speculate(
+    params: dict,
+    cfg: ModelConfig,
+    cache,
+    state: SlotState,
+    spec: SchedSpec,
+    page: jax.Array,  # int32 — merged page to fold this chunk into
+    *,
+    rounds: int,
+    k: int,
+    draft_layers: int,
+) -> tuple:
+    """Self-speculative variant of ``scheduler_decode_chunk``: ``rounds``
+    rounds of (k early-exit drafts + one k+1-wide full verify) per chunk
+    (the round loop itself is ``_spec_core``, shared with the paged path).
+
+    Returns tokens ``[B, rounds*(k+1)]`` FRONT-PACKED per row (col count in
+    flags) and a ``[3B + 2]`` flags vector: ``[done | n_emitted |
+    emitted_this_chunk | accepted_total, drafted_total]`` — one host copy
+    per chunk, same as the non-speculative contract."""
+    cache, state, tokens, wcur, acc_total, drf_total = _spec_core(
+        params, cfg, cache, state, spec,
+        rounds=rounds, k=k, draft_layers=draft_layers,
+    )
     if _use_merged(cfg):
         # Compacting merge: only the ACCEPTED ring slots land, at each
         # row's next free merged position, so the merged tier stays as
@@ -1338,11 +1390,87 @@ def scheduler_decode_chunk_speculate(
         # — compaction is count-addressed, not page-addressed.
         del page
         cache = merge_chunk_compact(cache, cfg)
-    state = state._replace(
-        prev=prev, done=done, n_emitted=n_emitted, keydata=keydata, tail=tail
-    )
     flags = jnp.concatenate([
-        done.astype(jnp.int32), n_emitted, wcur,
+        state.done.astype(jnp.int32), state.n_emitted, wcur,
         jnp.stack([acc_total, drf_total]),
     ])
     return cache, state, tokens, flags
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def scheduler_stage_paged(
+    params: dict,
+    cfg: ModelConfig,
+    ppk: jax.Array,  # [L, Pp, pg, KVH, KD] — prompt page pool
+    ppv: jax.Array,  # [L, Pp, pg, KVH, VD] (VD may be 0 for MLA)
+    spec: SchedSpec,
+    ptab: jax.Array,  # [R, NPb] int32 — radix-matched prefix pages (sentinel pad)
+    prefix_len: jax.Array,  # [R] int32 — matched prefix tokens (h * page_size)
+    suffix_ids: jax.Array,  # [R, Sb] left-padded UNMATCHED prompt remainder
+    suffix_mask: jax.Array,  # [R, Sb]
+    new_layer: jax.Array,  # [R] int32
+    new_strength: jax.Array,  # [R] f32
+    new_vectors: jax.Array,  # [R, H] f32
+    new_start: jax.Array,  # [R] int32, PADDED Sb-WINDOW coords
+    new_budget: jax.Array,  # [R] int32
+    new_keydata: jax.Array,  # [R, 2] uint32
+) -> tuple:
+    """``scheduler_stage`` against the PROMPT PAGE POOL: prefill incoming
+    trials' unmatched prompt remainders conditioned on their radix-matched
+    prefix pages.
+
+    Where the classic stage broadcasts ONE batch-1 prefix into every row,
+    here each row gathers its own prefix from pool pages (``ptab`` +
+    ``prefix_len`` are runtime operands — a row with no radix hit passes
+    ``prefix_len`` 0 and all-sentinel pages and simply prefills its whole
+    prompt through the ring). Prefill split-point invariance (the blocked
+    prefill path's guarantee) makes the resulting KV and first-token logits
+    bit-identical to an unsplit prefill of the full prompt.
+
+    Returns the same 9-tuple as ``scheduler_stage``; ``sk``/``sv`` are the
+    suffix ring KV ``[L, R, Sb, ...]`` which ``runtime.paged.paged_admit``
+    scatters into freshly allocated pool pages."""
+    R, Sb = suffix_ids.shape
+    dtype = params["embed"].dtype
+    pg = ppk.shape[2]
+    NPb = ptab.shape[1]
+
+    k, v, smask0, pos0 = gather_prompt_pages(ppk, ppv, ptab, prefix_len)
+    cache = init_cache(cfg, R, NPb * pg, dtype, ring_len=Sb)
+    cache = cache._replace(
+        k=k, v=v if cache.v.shape[-1] else cache.v,
+        slot_mask=smask0, positions=pos0, length=jnp.int32(NPb * pg),
+    )
+    # Same rematerialization hazard as scheduler_stage: one gather temp.
+    cache = lax.optimization_barrier(cache)
+
+    amask = suffix_mask
+    prompt_pos_mask = (
+        (jnp.arange(Sb)[None, :] >= new_start[:, None]) & (amask > 0)
+    ).astype(jnp.float32)
+    steer_prompt = SteerSpec(
+        new_layer, new_strength, new_vectors, prompt_pos_mask
+    )
+    suffix_pos = prefix_len[:, None] + make_positions(amask)
+    r = forward(
+        params, cfg, suffix_ids, amask, suffix_pos,
+        cache=cache, steer=steer_prompt, use_cache=True, logits_mode="last",
+    )
+    rc = r.cache
+    sk = jnp.swapaxes(rc.rk, 1, 2)  # [L, R, Sb, KVH, KD], cache dtype
+    sv = jnp.swapaxes(rc.rv, 1, 2)
+    smask = (
+        jnp.arange(Sb, dtype=jnp.int32)[None, :] < rc.rlen
+    ) & rc.rvalid
+    spos = rc.rpos
+
+    tok0, keydata = _slot_sample(r.logits, new_keydata, spec.temperature)
+    done0 = jnp.isin(tok0, spec.eos_ids) | (new_budget <= 1)
+    stop = spec.stop_seqs
+    if stop is not None and stop.shape[0] > 0:
+        tail0 = jnp.full((R, stop.shape[1]), -2, jnp.int32).at[:, -1].set(tok0)
+        done0 = done0 | _stop_hit(stop, tail0)
+    else:
+        tail0 = jnp.zeros((R, 0), jnp.int32)
+    true_sfx = amask.sum(axis=1).astype(jnp.int32)
+    return sk, sv, smask, spos, tok0, done0, true_sfx, keydata, tail0

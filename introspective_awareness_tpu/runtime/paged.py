@@ -1,0 +1,254 @@
+"""Paged-KV device executables for the continuous-batching scheduler.
+
+The classic scheduler keeps one monolithic ``[B, P0 + Ss]`` slot cache whose
+first ``P0`` slots hold ONE broadcast prefix — which is why divergent-suffix
+queues fall off the scheduled path entirely (no common prefix → no cache).
+The paged variant stores ALL prompt KV in a static prompt page pool and all
+folded decode KV in a static decode page pool (``models.transformer.
+init_page_pools``); per-slot int32 page tables are runtime operands, so
+which pages a slot reads is a host decision that never recompiles anything.
+
+Per decode chunk the executable GATHERS the referenced pages into an
+ordinary :class:`~introspective_awareness_tpu.models.transformer.KVCache`
+(prompt pages → slot tier, decode pages → merged tier, fresh chunk ring)
+and runs the exact chunk core the classic executables run
+(``runtime.generate._chunk_core`` / ``_spec_core``). The tier partition,
+positions, and per-tier reduction order are identical to the classic cache
+— masked tail slots contribute exact ``+0.0`` under the ``_NEG_INF``
+softmax — so paged decode is bit-identical to the broadcast-prefix path,
+greedy and sampled, speculative included (asserted by
+tests/test_paged_kv.py). The gather cost is paid once per chunk
+(``RING_CHUNK`` steps), not per step.
+
+Host-side page accounting (radix tree, refcounts, eviction) lives in
+``runtime.radix``; the driving loop is
+``runtime.scheduler.run_scheduled_paged``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from introspective_awareness_tpu.models.config import ModelConfig
+from introspective_awareness_tpu.models.transformer import (
+    KVCache,
+    gather_decode_pages,
+    gather_prompt_pages,
+    pool_fold_chunk,
+    pool_fold_chunk_compact,
+)
+from introspective_awareness_tpu.runtime.generate import (
+    SchedSpec,
+    SlotState,
+    _chunk_core,
+    _spec_core,
+)
+
+
+def _assemble(
+    ppk, ppv, dpk, dpv, mpos, mvalid,
+    state: SlotState, ptab, dtab, ring_len: int, ring_valid: bool,
+) -> KVCache:
+    """Gather the slot's pages into a classic three-tier KVCache view.
+
+    Prompt pages become the (frozen) slot tier — each slot's prompt sits
+    contiguously at positions ``[0, true_len)``; decode pages become the
+    merged tier in logical page order (``dtab`` maps logical → pool);
+    the chunk ring starts fresh (``rlen`` 0 — every ring slot is written
+    by the chunk before any read of it, so zeros are never observed).
+    ``mlen`` is pinned to the full merged width exactly like
+    ``scheduler_init`` (page recycling: ``mvalid`` alone gates reads)."""
+    B = state.prev.shape[0]
+    L = ppk.shape[0]
+    k, v, smask, pos = gather_prompt_pages(ppk, ppv, ptab, state.true_len)
+    mk, mv = gather_decode_pages(dpk, dpv, dtab)
+    kvh_kd = ppk.shape[3:]
+    kvh_vd = ppv.shape[3:]
+    rvalid_init = jnp.ones if ring_valid else jnp.zeros
+    return KVCache(
+        k=k, v=v, slot_mask=smask, positions=pos,
+        length=jnp.int32(k.shape[2]),
+        rk=jnp.zeros((L, ring_len, B) + kvh_kd, ppk.dtype),
+        rv=jnp.zeros((L, ring_len, B) + kvh_vd, ppv.dtype),
+        rpos=jnp.zeros((B, ring_len), jnp.int32),
+        rvalid=rvalid_init((B, ring_len), jnp.bool_),
+        rlen=jnp.int32(0),
+        mk=mk, mv=mv, mpos=mpos, mvalid=mvalid,
+        mlen=jnp.int32(mvalid.shape[1]),
+    )
+
+
+@partial(
+    jax.jit,
+    donate_argnames=("ppk", "ppv", "state", "mvalid"),
+)
+def paged_admit(
+    ppk: jax.Array,  # [L, Pp, pg, KVH, KD] — prompt page pool (DONATED)
+    ppv: jax.Array,  # [L, Pp, pg, KVH, VD] (DONATED)
+    state: SlotState,  # DONATED
+    spec: SchedSpec,
+    slot_map: jax.Array,  # [R] int32 — destination slot per staged row, -1 = skip
+    dest: jax.Array,  # [R, Sb] int32 — FLAT pool slot (page*pg + off) per suffix
+    #                   token; sentinel Pp*pg for pads/deferred rows
+    sk: jax.Array,  # [L, R, Sb, KVH, KD] staged suffix KV (cache dtype)
+    sv: jax.Array,  # [L, R, Sb, KVH, VD]
+    tok0: jax.Array,  # [R] int32
+    done0: jax.Array,  # [R] bool
+    true_ctx: jax.Array,  # [R] int32 — FULL prompt length (prefix + suffix)
+    new_budget: jax.Array,  # [R] int32
+    new_layer: jax.Array,  # [R] int32
+    new_strength: jax.Array,  # [R] f32
+    new_vectors: jax.Array,  # [R, H] f32
+    new_keydata: jax.Array,  # [R, 2] uint32 — ADVANCED keydata from stage
+    new_tail: jax.Array,  # [R, Ls] int32 (Ls may be 0)
+    mvalid: jax.Array,  # [B, PS*ch] bool — decode-tier validity (DONATED)
+) -> tuple:
+    """``scheduler_admit`` for the paged cache: scatter staged suffix KV
+    into freshly allocated PROMPT POOL pages and the trial state into its
+    slot. FLOP-free; the radix-matched prefix pages need no copy at all —
+    the host just points the slot's page table at them.
+
+    ``dest`` is host-computed: suffix token j of staged row r lands at
+    flat pool coordinate ``page[j // pg] * pg + j % pg`` of the row's
+    fresh pages (sentinel drops pads and deferred rows). The admitted
+    slots' decode-tier ``mvalid`` rows are cleared so no previous
+    tenant's folded chunks remain readable. Returns
+    ``(ppk, ppv, mvalid, state, tok0_b, flags)`` with the usual
+    ``[done | n_emitted]`` ``[2B]`` flags contract."""
+    B = state.prev.shape[0]
+    L, Pp, pg = ppk.shape[:3]
+
+    fk = ppk.reshape((L, Pp * pg) + ppk.shape[3:])
+    new_ppk = fk.at[:, dest].set(sk.astype(fk.dtype), mode="drop")
+    new_ppk = new_ppk.reshape(ppk.shape)
+    if ppv.shape[-1]:
+        fv = ppv.reshape((L, Pp * pg) + ppv.shape[3:])
+        new_ppv = fv.at[:, dest].set(sv.astype(fv.dtype), mode="drop")
+        new_ppv = new_ppv.reshape(ppv.shape)
+    else:
+        new_ppv = ppv
+
+    # Invert the row→slot map (slot_map values unique by construction).
+    hit = slot_map[None, :] == jnp.arange(B, dtype=jnp.int32)[:, None]
+    m = jnp.any(hit, axis=1)  # [B]
+    row = jnp.argmax(hit, axis=1).astype(jnp.int32)  # [B]
+    sel2 = m[:, None]
+
+    new_mvalid = mvalid & ~sel2
+
+    tok0_b = jnp.where(m, tok0[row], spec.pad_id)
+    state = SlotState(
+        prev=jnp.where(m, tok0[row], state.prev),
+        done=jnp.where(m, done0[row], state.done),
+        n_emitted=jnp.where(m, 1, state.n_emitted),
+        true_len=jnp.where(m, true_ctx[row], state.true_len),
+        budget=jnp.where(m, new_budget[row], state.budget),
+        steer_layer=jnp.where(m, new_layer[row], state.steer_layer),
+        steer_strength=jnp.where(m, new_strength[row], state.steer_strength),
+        steer_vectors=jnp.where(sel2, new_vectors[row], state.steer_vectors),
+        keydata=jnp.where(sel2, new_keydata[row], state.keydata),
+        tail=jnp.where(sel2, new_tail[row], state.tail),
+    )
+    flags = jnp.concatenate([state.done.astype(jnp.int32), state.n_emitted])
+    return new_ppk, new_ppv, new_mvalid, state, tok0_b, flags
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "ch"),
+    donate_argnames=("dpk", "dpv", "mpos", "mvalid", "state"),
+)
+def paged_decode_chunk(
+    params: dict,
+    cfg: ModelConfig,
+    ppk: jax.Array,  # [L, Pp, pg, KVH, KD] — read-only this call
+    ppv: jax.Array,
+    dpk: jax.Array,  # [L, Pd, ch, KVH, KD] — decode page pool (DONATED)
+    dpv: jax.Array,  # (DONATED)
+    mpos: jax.Array,  # [B, PS*ch] int32 (DONATED)
+    mvalid: jax.Array,  # [B, PS*ch] bool (DONATED)
+    state: SlotState,  # DONATED
+    spec: SchedSpec,
+    ptab: jax.Array,  # [B, NP] int32 — prompt pages per slot
+    dtab: jax.Array,  # [B, PS] int32 — decode pages per slot (logical order)
+    page: jax.Array,  # int32 — LOGICAL page to fold this chunk into
+    *,
+    ch: int,
+) -> tuple:
+    """``scheduler_decode_chunk`` over gathered pages: assemble each slot's
+    classic cache view from the pools, run the shared ``_chunk_core``, and
+    fold the chunk ring into each slot's pool page for logical page
+    ``page`` (host passes the global chunk counter mod the page-plan
+    count, exactly like the classic merged tier). Returns
+    ``(dpk, dpv, mpos, mvalid, state, tokens, flags)``."""
+    cache = _assemble(
+        ppk, ppv, dpk, dpv, mpos, mvalid, state, ptab, dtab,
+        ring_len=ch, ring_valid=False,
+    )
+    cache = lax.optimization_barrier(cache)
+    cache, state, tokens = _chunk_core(
+        params, cfg, cache, state, spec, ch=ch
+    )
+    dpk, dpv, mpos, mvalid = pool_fold_chunk(
+        dpk, dpv, mpos, mvalid, cache, dtab, page
+    )
+    flags = jnp.concatenate([state.done.astype(jnp.int32), state.n_emitted])
+    return dpk, dpv, mpos, mvalid, state, tokens, flags
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "rounds", "k", "draft_layers"),
+    donate_argnames=("dpk", "dpv", "mpos", "mvalid", "state"),
+)
+def paged_decode_chunk_speculate(
+    params: dict,
+    cfg: ModelConfig,
+    ppk: jax.Array,
+    ppv: jax.Array,
+    dpk: jax.Array,
+    dpv: jax.Array,
+    mpos: jax.Array,
+    mvalid: jax.Array,
+    state: SlotState,
+    spec: SchedSpec,
+    ptab: jax.Array,
+    dtab: jax.Array,
+    *,
+    rounds: int,
+    k: int,
+    draft_layers: int,
+) -> tuple:
+    """Speculative paged chunk: shared ``_spec_core`` over the gathered
+    view, compacting fold (``pool_fold_chunk_compact`` — count-addressed,
+    so no ``page`` operand) into the decode pool. Same ``[3B + 2]`` flags
+    contract as ``scheduler_decode_chunk_speculate``."""
+    W = rounds * (k + 1)
+    cache = _assemble(
+        ppk, ppv, dpk, dpv, mpos, mvalid, state, ptab, dtab,
+        ring_len=W, ring_valid=True,
+    )
+    cache = lax.optimization_barrier(cache)
+    cache, state, tokens, wcur, acc_total, drf_total = _spec_core(
+        params, cfg, cache, state, spec,
+        rounds=rounds, k=k, draft_layers=draft_layers,
+    )
+    dpk, dpv, mpos, mvalid = pool_fold_chunk_compact(
+        dpk, dpv, mpos, mvalid, cache, dtab
+    )
+    flags = jnp.concatenate([
+        state.done.astype(jnp.int32), state.n_emitted, wcur,
+        jnp.stack([acc_total, drf_total]),
+    ])
+    return dpk, dpv, mpos, mvalid, state, tokens, flags
+
+
+__all__ = [
+    "paged_admit",
+    "paged_decode_chunk",
+    "paged_decode_chunk_speculate",
+]

@@ -1,0 +1,209 @@
+"""Host-side page accounting for the paged KV cache: a reference-counted
+page pool plus a radix tree over full-page token chunks.
+
+Everything here is plain Python/host state — the device only ever sees the
+static page pools and int32 page-index tables (``runtime.paged``). The tree
+keys nodes by the exact token tuple of one page, so a node's pool page
+holds KV that is valid if and only if the trial's prompt starts with the
+root→node token path — prefix sharing is therefore exact, not fuzzy, and
+admission of a radix-hit trial is a page-table edit (no FLOPs, no copy).
+
+Lifecycle of a prompt page:
+
+- ``alloc`` hands it to an admitted trial (refcount 1 via ``retain``).
+- ``insert`` may additionally mark it *cached*: the tree now owns one
+  logical reference, so the page survives harvest (refcount 0) for future
+  radix hits instead of returning to the free list.
+- later trials that radix-hit it ``retain`` it again (share, no copy).
+- ``evict`` (LRU, leaf-only) drops cached pages with refcount 0 back to
+  the free list when admission runs out of pages.
+
+Steered prompts only share their steer-FREE prefix: KV written at or after
+the steering start is contaminated by the injected vector, so the caller
+caps both lookup and insert at the trial's steering start (see
+``runtime.scheduler.run_scheduled_paged``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class PagePool:
+    """Free-list page allocator with host-side reference counts.
+
+    ``refcount`` tracks resident-slot references; ``cached`` marks pages
+    owned by the radix tree (kept alive at refcount 0). A page returns to
+    the free list only when it is neither referenced nor cached."""
+
+    def __init__(self, n_pages: int) -> None:
+        self.n_pages = int(n_pages)
+        self._free: list[int] = list(range(self.n_pages - 1, -1, -1))
+        self.refcount = [0] * self.n_pages
+        self.cached = [False] * self.n_pages
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        """Pages NOT on the free list (referenced or cached)."""
+        return self.n_pages - len(self._free)
+
+    @property
+    def cached_count(self) -> int:
+        return sum(self.cached)
+
+    def alloc(self, n: int) -> Optional[list[int]]:
+        """Pop ``n`` pages, or None (caller evicts and retries). All-or-
+        nothing so a half-admitted trial never strands pages."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self.refcount[p] = 1
+        return pages
+
+    def retain(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            self.refcount[p] += 1
+
+    def release(self, pages: Sequence[int]) -> list[int]:
+        """Drop one reference per page; returns the pages actually freed
+        (refcount 0 and not cached)."""
+        freed: list[int] = []
+        for p in pages:
+            self.refcount[p] -= 1
+            assert self.refcount[p] >= 0, f"page {p} over-released"
+            if self.refcount[p] == 0 and not self.cached[p]:
+                self._free.append(p)
+                freed.append(p)
+        return freed
+
+    def mark_cached(self, page: int) -> None:
+        self.cached[page] = True
+
+    def uncache(self, page: int) -> bool:
+        """Radix eviction hook: drop the tree's ownership; frees the page
+        if no slot references it. Returns True when the page was freed."""
+        self.cached[page] = False
+        if self.refcount[page] == 0:
+            self._free.append(page)
+            return True
+        return False
+
+
+class _Node:
+    __slots__ = ("children", "page", "parent", "key", "last_use")
+
+    def __init__(self, page: int, parent: "_Node", key: tuple) -> None:
+        self.children: dict[tuple, _Node] = {}
+        self.page = page
+        self.parent = parent
+        self.key = key
+        self.last_use = 0
+
+
+class RadixTree:
+    """Radix (token-chunk trie) index over cached prompt pages.
+
+    Granularity is one PAGE: edges are labelled with ``page_size``-token
+    tuples, so a depth-h match means the first ``h * page_size`` prompt
+    tokens are byte-for-byte resident in the pool."""
+
+    def __init__(self, page_size: int, pool: PagePool) -> None:
+        self.page_size = int(page_size)
+        self.pool = pool
+        self._root = _Node(-1, None, ())  # type: ignore[arg-type]
+        self._clock = 0
+        self._n_nodes = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _chunks(self, tokens: Sequence[int], limit_tokens: int):
+        pg = self.page_size
+        n = min(len(tokens), max(0, int(limit_tokens)))
+        for o in range(0, n - pg + 1, pg):
+            yield tuple(int(t) for t in tokens[o:o + pg])
+
+    def lookup(
+        self, tokens: Sequence[int], limit_tokens: Optional[int] = None
+    ) -> list[int]:
+        """Longest cached full-page prefix of ``tokens`` (capped at
+        ``limit_tokens``). Returns the matched pool pages in prompt order;
+        the caller must ``retain`` them before using them. Bumps LRU
+        clocks along the path."""
+        if limit_tokens is None:
+            limit_tokens = len(tokens)
+        now = self._tick()
+        node, pages = self._root, []
+        for chunk in self._chunks(tokens, limit_tokens):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.last_use = now
+            pages.append(child.page)
+            node = child
+        return pages
+
+    def insert(
+        self, tokens: Sequence[int], pages: Sequence[int],
+        limit_tokens: Optional[int] = None,
+    ) -> int:
+        """Cache the full-page chunks of ``tokens`` (up to
+        ``limit_tokens``), backed by ``pages`` (the trial's prompt pages in
+        order — matched AND fresh). Existing nodes win on collision (their
+        page already holds identical KV; the trial keeps using its own
+        table entry either way). Returns the number of NEWLY cached
+        pages."""
+        if limit_tokens is None:
+            limit_tokens = len(tokens)
+        now = self._tick()
+        node, added = self._root, 0
+        for i, chunk in enumerate(self._chunks(tokens, limit_tokens)):
+            child = node.children.get(chunk)
+            if child is None:
+                child = _Node(int(pages[i]), node, chunk)
+                node.children[chunk] = child
+                self.pool.mark_cached(child.page)
+                self._n_nodes += 1
+                added += 1
+            child.last_use = now
+            node = child
+        return added
+
+    def evict(self, need: int) -> int:
+        """Free at least ``need`` pages by evicting LRU cached pages,
+        leaves first (an interior node's children would dangle without
+        their prefix). Only refcount-0 pages are evictable — a page some
+        slot still reads must survive. Returns pages actually freed."""
+        freed = 0
+        while freed < need:
+            victim: Optional[_Node] = None
+            stack = [self._root]
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                if (
+                    n is not self._root and not n.children
+                    and self.pool.refcount[n.page] == 0
+                    and (victim is None or n.last_use < victim.last_use)
+                ):
+                    victim = n
+            if victim is None:
+                break
+            del victim.parent.children[victim.key]
+            self._n_nodes -= 1
+            if self.pool.uncache(victim.page):
+                freed += 1
+        return freed
+
+    @property
+    def n_nodes(self) -> int:
+        return self._n_nodes
+
+
+__all__ = ["PagePool", "RadixTree"]

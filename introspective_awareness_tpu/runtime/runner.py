@@ -28,11 +28,16 @@ from introspective_awareness_tpu.models.tokenizer import Tokenizer, pad_batch
 from introspective_awareness_tpu.obs import NullLedger
 from introspective_awareness_tpu.obs.preflight import (
     autotune as _hbm_autotune,
+    modeled_padded_bytes as _modeled_bytes,
     preflight as _hbm_preflight,
 )
 from introspective_awareness_tpu.parallel import ShardingRules
 from introspective_awareness_tpu.parallel import sharding as shax
-from introspective_awareness_tpu.models.transformer import forward, make_positions
+from introspective_awareness_tpu.models.transformer import (
+    forward,
+    init_page_pools,
+    make_positions,
+)
 from introspective_awareness_tpu.runtime.generate import (
     GenSpec,
     _use_merged,
@@ -41,8 +46,11 @@ from introspective_awareness_tpu.runtime.generate import (
 )
 from introspective_awareness_tpu.runtime.journal import SweepInterrupted
 from introspective_awareness_tpu.runtime.scheduler import (
+    PagedTrial,
     TrialRequest,
+    paged_pool_sizes,
     run_scheduled,
+    run_scheduled_paged,
 )
 
 
@@ -67,6 +75,9 @@ class ModelRunner:
         hbm_budget_frac: Optional[float] = None,
         prefill_batch_chunk: Optional[int] = None,
         prefill_suffix_chunk: Optional[int] = None,
+        kv_paged: str = "auto",
+        kv_page_size: int = 16,
+        kv_pool_pages: Optional[int] = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -96,6 +107,20 @@ class ModelRunner:
         # requested batch until the AOT memory plan fits the budget.
         self.prefill_batch_chunk = prefill_batch_chunk
         self.prefill_suffix_chunk = prefill_suffix_chunk
+        # Paged KV cache (runtime.scheduler.run_scheduled_paged): "auto"
+        # routes scheduled queues that would otherwise hit the fixed-batch
+        # fallback (no broadcastable shared prefix) through the page pool +
+        # radix prefix sharing instead; "on" forces every scheduled queue
+        # paged; "off" keeps the classic two-tier path exclusively.
+        # kv_pool_pages bounds the prompt page pool (None = safe minimum;
+        # with an HBM budget set, _paged_pool_autotune walks candidates).
+        if kv_paged not in ("auto", "on", "off"):
+            raise ValueError(
+                f"kv_paged must be 'auto', 'on', or 'off', got {kv_paged!r}"
+            )
+        self.kv_paged = kv_paged
+        self.kv_page_size = int(kv_page_size)
+        self.kv_pool_pages = kv_pool_pages
         self.last_autotune: Optional[dict] = None
         self._aot_cache: dict = {}
         # Sequence parallelism: with a seq mesh axis > 1, S>1 chunks attend
@@ -312,6 +337,82 @@ class ModelRunner:
         self.last_autotune = result.as_dict()
         self._aot_cache[key] = result.compiled
         return result.compiled
+
+    _DT_SHORT = {
+        "float32": "f32", "bfloat16": "bf16", "float16": "f16",
+        "float8_e4m3fn": "f8e4m3fn", "float8_e5m2": "f8e5m2",
+        "int32": "s32", "int8": "s8", "bool": "pred",
+    }
+
+    def _paged_pool_bytes(self, geom: dict, prompt_pages: int) -> int:
+        """Modeled resident HBM of the page pools at a candidate prompt-pool
+        size: ``jax.eval_shape`` over ``init_page_pools`` (exact shapes, no
+        compile) folded through the TPU tiling model
+        (``obs.preflight.modeled_padded_bytes`` — the r05 padding
+        multiplier), so the budget walk sees real allocations."""
+        shapes = jax.eval_shape(
+            lambda: init_page_pools(
+                self.cfg, prompt_pages=prompt_pages,
+                page_size=geom["page_size"],
+                decode_pages=geom["decode_pages"],
+                chunk_len=geom["ring_width"],
+                dtype=self.params["embed"].dtype,
+            )
+        )
+        total = 0
+        for leaf in jax.tree.leaves(shapes):
+            short = self._DT_SHORT.get(leaf.dtype.name)
+            b = (
+                _modeled_bytes(short, list(leaf.shape))
+                if short is not None else None
+            )
+            if b is None:  # unknown dtype: fall back to nominal bytes
+                b = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            total += b
+        return total
+
+    def _paged_pool_autotune(self, geom: dict) -> int:
+        """Pick the prompt-pool page count under the HBM budget.
+
+        Candidates walk from the configured/default headroom size (extra
+        pages above the floor = radix cache capacity) down by halving to the
+        safe minimum (every slot resident at full prompt width plus one
+        admission wave). Each candidate's footprint is the modeled pool
+        bytes as a ``CompiledMemoryStats``-style object — the pool arrays
+        are donated through every paged executable, so args alias outputs
+        and the resident cost is one copy. The decision lands in
+        ``last_autotune["kv_pool"]`` (→ sweep manifest) and an
+        ``autotune_decision`` ledger event."""
+        floor = geom["min_prompt_pages"]
+        top = max(int(self.kv_pool_pages or floor * 4), floor)
+        cands, c = [], top
+        while True:
+            cands.append(c)
+            if c <= floor:
+                break
+            c = max(floor, c // 2)
+
+        def build(pp):
+            b = self._paged_pool_bytes(geom, pp)
+
+            class _PoolStats:
+                argument_size_in_bytes = b
+                output_size_in_bytes = b
+                alias_size_in_bytes = b  # donated: one resident copy
+                temp_size_in_bytes = 0
+                generated_code_size_in_bytes = 0
+
+            return _PoolStats()
+
+        result = _hbm_autotune(
+            cands, build, label="kv_page_pool",
+            budget_frac=self.hbm_budget_frac,
+            ledger=self.ledger,
+        )
+        self.last_autotune = {
+            **(self.last_autotune or {}), "kv_pool": result.as_dict(),
+        }
+        return int(result.chosen)
 
     def _decode_row(self, row: np.ndarray) -> str:
         out = []
@@ -739,14 +840,20 @@ class ModelRunner:
         to the scheduler loop; the fixed-batch fallback has no chunk
         boundaries to record and ignores it.
 
-        Eligibility mirrors the shared-prefix path — every prompt must
-        share a prefix no steered row steers inside (the sweep's preamble),
-        no sequence-parallel mesh, and the merged decode tier must be
-        active. Ineligible queues fall back to the fixed-batch path in
-        ``slots``-sized chunks; a mixed-budget queue is grouped by budget
-        first (one batch call per budget group — a single batch call has
-        one ``max_new_tokens``, and truncating per-trial after the fact
-        would change sampled text), preserving input order in the result.
+        Eligibility: no sequence-parallel mesh and an active merged decode
+        tier. Within that, queues with a broadcastable shared prefix run
+        the classic two-tier scheduler; queues WITHOUT one (divergent
+        suffixes, per-family preambles, a row steering its whole prompt)
+        run the paged scheduler (``kv_paged="auto"``), where prefix
+        sharing is per-trial radix dedup against resident pages instead of
+        a queue-wide broadcast — both bit-identical per trial.
+        ``kv_paged="on"`` forces every scheduled queue paged;
+        ``kv_paged="off"`` restores the old behavior, where prefix-less
+        queues fall back to the fixed-batch path in ``slots``-sized
+        chunks: a mixed-budget queue is grouped by budget first (one batch
+        call per budget group — a single batch call has one
+        ``max_new_tokens``, and truncating per-trial after the fact would
+        change sampled text), preserving input order in the result.
 
         ``speculate_k > 0`` runs decode chunks self-speculatively: the
         first ``draft_layers`` layers (default ``n_layers // 2``) + the
@@ -806,10 +913,31 @@ class ModelRunner:
                 )
 
         rows = [self.tokenizer.encode(p) for p in prompts]
+        eligible = self.sp_mesh is None and _use_merged(self.cfg)
         L0 = 0
-        if self.sp_mesh is None and _use_merged(self.cfg):
+        if eligible:
             L0 = self._prefix_split(
                 rows, strength_arr, steering_start_positions
+            )
+        # Paged KV routing: queues with no broadcastable shared prefix
+        # (L0 == 0) no longer fall off the scheduled path — the page pool
+        # needs no queue-wide prefix, and the radix tree still dedups
+        # whatever prefixes subsets of the queue DO share. kv_paged="on"
+        # additionally routes shareable queues paged (A/B and forcing);
+        # "off" restores the classic two-tier + fixed-batch behavior.
+        if eligible and self.kv_paged != "off" and (
+            self.kv_paged == "on" or L0 == 0
+        ):
+            return self._generate_scheduled_paged(
+                rows, layer_arr, steering_vectors, strength_arr,
+                steering_start_positions, budget_list,
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                seed=seed, stop_strings=stop_strings, slots=slots,
+                refill_frac=refill_frac, pipeline=pipeline,
+                suffix_bucket=suffix_bucket, result_cb=result_cb,
+                trial_ids=trial_ids, stop_event=stop_event, faults=faults,
+                trace=trace, speculate_k=speculate_k,
+                draft_layers=int(draft_layers) if speculate_k else 0,
             )
         if L0 == 0:
             if speculate_k:
@@ -936,6 +1064,107 @@ class ModelRunner:
                 replica=str(getattr(self, "replica_label", "0")),
                 speculate_k=speculate_k,
                 draft_layers=int(draft_layers) if speculate_k else 0,
+            )
+            done = [r for r in results if r is not None]
+            span.add_evals(len(done))
+            span.add_tokens(int(sum(len(r) for r in done)))
+            span.set(**stats)
+            if stats.get("interrupted"):
+                raise SweepInterrupted(
+                    f"stop requested; {len(done)}/{N} trials decoded"
+                )
+        return [
+            texts[i] if i in texts else self._decode_row(results[i])
+            for i in range(N)
+        ]
+
+    def _generate_scheduled_paged(
+        self,
+        rows: list,
+        layer_arr: np.ndarray,
+        steering_vectors: Sequence[np.ndarray],
+        strength_arr: np.ndarray,
+        steering_start_positions: Optional[Sequence[Optional[int]]],
+        budget_list: list[int],
+        *,
+        max_new_tokens: int,
+        temperature: float,
+        seed: Optional[int],
+        stop_strings: Optional[Sequence[str]],
+        slots: int,
+        refill_frac: float,
+        pipeline: bool,
+        suffix_bucket: int,
+        result_cb: Optional[Callable[[int, str], None]],
+        trial_ids: Optional[Sequence[int]],
+        stop_event,
+        faults,
+        trace,
+        speculate_k: int,
+        draft_layers: int,
+    ) -> list[str]:
+        """Paged-KV scheduled generation (``run_scheduled_paged``): full
+        unpadded prompts queue directly — prefix sharing is per-trial radix
+        dedup against resident pages, not a queue-wide broadcast — so the
+        fixed-batch fallback class (divergent suffixes, per-family
+        preambles, whole-prompt steering on one row) decodes through slots
+        with per-trial budgets, PRNG streams, and speculation intact."""
+        N = len(rows)
+        trials = []
+        for i in range(N):
+            sp_i = (
+                None if steering_start_positions is None
+                else steering_start_positions[i]
+            )
+            trials.append(PagedTrial(
+                prompt_ids=np.asarray(rows[i], np.int32),
+                steer_layer=int(layer_arr[i]),
+                steer_strength=float(strength_arr[i]),
+                steer_vector=np.asarray(steering_vectors[i], np.float32),
+                steer_start=0 if sp_i is None else int(sp_i),
+                budget=budget_list[i],
+            ))
+        geom = paged_pool_sizes(
+            trials, slots, self.kv_page_size, max_new_tokens,
+            speculate_k=speculate_k,
+        )
+        if self.hbm_budget_frac is not None:
+            pool_pages = self._paged_pool_autotune(geom)
+        else:
+            pool_pages = max(
+                int(self.kv_pool_pages or 0), geom["min_prompt_pages"]
+            )
+        if seed is None:
+            self._calls += 1
+            seed = self._seed * 1_000_003 + self._calls
+        stop = self._stop_token_seqs(stop_strings) if stop_strings else None
+        texts: dict[int, str] = {}
+        tok_cb = None
+        if result_cb is not None:
+            def tok_cb(i: int, toks: np.ndarray) -> None:
+                texts[i] = self._decode_row(toks)
+                result_cb(i, texts[i])
+        with self.ledger.span(
+            "generate_scheduled", trials=N, slots=slots, paged=True,
+            page_size=int(self.kv_page_size), pool_pages=int(pool_pages),
+            max_new_tokens=int(max_new_tokens), model=self.model_name,
+        ) as span:
+            results, stats = run_scheduled_paged(
+                self.params, self.cfg, trials,
+                slots=slots, max_new_tokens=max_new_tokens,
+                page_size=self.kv_page_size,
+                prompt_pool_pages=pool_pages,
+                temperature=temperature,
+                eos_ids=list(self.tokenizer.eos_ids),
+                pad_id=int(self.tokenizer.pad_id),
+                stop_seqs=None if stop is None else np.asarray(stop),
+                seed=int(seed), refill_frac=refill_frac,
+                ledger=self.ledger, pipeline=pipeline,
+                suffix_bucket=suffix_bucket, result_cb=tok_cb,
+                trial_ids=trial_ids, stop_event=stop_event, faults=faults,
+                trace=trace,
+                replica=str(getattr(self, "replica_label", "0")),
+                speculate_k=speculate_k, draft_layers=draft_layers,
             )
             done = [r for r in results if r is not None]
             span.add_evals(len(done))

@@ -77,17 +77,27 @@ from introspective_awareness_tpu.obs import (
     StagedGauges,
 )
 from introspective_awareness_tpu.obs.registry import default_registry
+from introspective_awareness_tpu.models.transformer import init_page_pools
 from introspective_awareness_tpu.runtime.generate import (
     SchedSpec,
+    SlotState,
     _chunk_plan,
     _spec_chunk_plan,
+    _spec_merged_pages,
     scheduler_admit,
     scheduler_decode_chunk,
     scheduler_decode_chunk_speculate,
     scheduler_init,
     scheduler_refill,
     scheduler_stage,
+    scheduler_stage_paged,
 )
+from introspective_awareness_tpu.runtime.paged import (
+    paged_admit,
+    paged_decode_chunk,
+    paged_decode_chunk_speculate,
+)
+from introspective_awareness_tpu.runtime.radix import PagePool, RadixTree
 
 import jax.numpy as jnp
 
@@ -769,6 +779,643 @@ def run_scheduled(
         "interrupted": bool(interrupted),
         "speculate_k": int(speculate_k),
         "draft_layers": int(draft_layers) if speculate_k else 0,
+        **gauges.as_stats(wall_s, chunks_done),
+        **sgauges.as_stats(),
+        **pgauges.as_stats(),
+    }
+    return results, stats
+
+
+@dataclass(frozen=True)
+class PagedTrial:
+    """One queued generation for the PAGED scheduler: the full UNPADDED
+    prompt plus its steering cell. No shared-prefix split is declared —
+    prefix sharing is discovered per trial by the radix tree, so queues
+    with no global common prefix (the classic fixed-batch fallback class)
+    run scheduled too."""
+
+    prompt_ids: np.ndarray  # [len] int32 — full unpadded prompt
+    steer_layer: int
+    steer_strength: float
+    steer_vector: np.ndarray  # [H] f32
+    steer_start: int  # UNPADDED prompt coords; 0 = steer the whole prompt
+    budget: int
+
+
+def paged_pool_sizes(
+    trials: Sequence["PagedTrial"], slots: int, page_size: int,
+    max_new_tokens: int, speculate_k: int = 0,
+) -> dict:
+    """Static pool geometry for a queue: prompt-page width per slot
+    (``np_max``), the minimum safe prompt pool (every slot resident with a
+    full-width prompt, plus one admission in flight), and the decode pool
+    (fixed per-slot pages — decode KV is never shared). Shared by
+    ``run_scheduled_paged``, the runner's HBM autotune candidates, and
+    bench's memory model."""
+    pg = int(page_size)
+    np_max = max(1, -(-max(int(t.prompt_ids.shape[0]) for t in trials) // pg))
+    if speculate_k:
+        n_chunks, rounds = _spec_chunk_plan(max_new_tokens, speculate_k)
+        ring_w = rounds * (speculate_k + 1)
+        ps = _spec_merged_pages(max_new_tokens, ring_w)
+    else:
+        n_chunks, ring_w = _chunk_plan(max_new_tokens)
+        ps = n_chunks
+    return {
+        "page_size": pg,
+        "np_max": np_max,
+        "min_prompt_pages": (slots + 1) * np_max,
+        "decode_pages": slots * ps,
+        "decode_pages_per_slot": ps,
+        "ring_width": ring_w,
+    }
+
+
+def run_scheduled_paged(
+    params: dict,
+    cfg: ModelConfig,
+    trials: Sequence[PagedTrial],
+    *,
+    slots: int,
+    max_new_tokens: int,
+    page_size: int = 16,
+    prompt_pool_pages: Optional[int] = None,
+    temperature: float = 0.0,
+    eos_ids: Sequence[int],
+    pad_id: int,
+    stop_seqs: Optional[np.ndarray] = None,
+    seed: int = 0,
+    refill_frac: float = 0.25,
+    ledger=None,
+    pipeline: bool = True,
+    suffix_bucket: int = 16,
+    result_cb: Optional[Callable[[int, np.ndarray], None]] = None,
+    trial_ids: Optional[Sequence[int]] = None,
+    stop_event=None,
+    faults=None,
+    trace=None,
+    replica: str = "0",
+    speculate_k: int = 0,
+    draft_layers: int = 0,
+) -> tuple[list[np.ndarray], dict]:
+    """``run_scheduled`` over the PAGED KV cache (``runtime.paged``).
+
+    Differences from the classic loop, and nothing else:
+
+    - No broadcast prefix and no fixed-batch precondition: each trial
+      carries its full prompt; at admission the radix tree finds the
+      longest cached full-page prefix, those pages are shared by table
+      edit (``prefix_share_hit``), and only the remainder is prefilled
+      (``scheduler_stage_paged``) into freshly allocated pages.
+    - Admission is always staged+admit (there is no synchronous refill
+      executable for pages); slot-map construction, queue order, PRNG
+      streams, flags contracts, pipelining, budget horizon, stop/fault/
+      trace handling all mirror the classic loop line for line.
+    - Harvest releases the trial's prompt pages; pages the radix tree
+      cached survive at refcount 0 for future hits and are LRU-evicted
+      only under allocation pressure.
+
+    Outputs are bit-identical to ``run_scheduled`` on the same queue (same
+    seed/stream ids, greedy AND sampled — per-trial PRNG streams are queue-
+    indexed, and the gathered page layout preserves the classic cache's
+    tier partition and reduction order; tests/test_paged_kv.py asserts it
+    across page sizes and slot counts, speculative included).
+
+    ``prompt_pool_pages`` (default: the ``paged_pool_sizes`` minimum)
+    bounds prompt KV HBM; extra headroom above the minimum becomes radix
+    cache capacity. Stats add ``share_hits``/``share_misses``/
+    ``share_hit_rate`` and page-pool occupancy readings."""
+    ledger = ledger if ledger is not None else NullLedger()
+    B = slots
+    N = len(trials)
+    pg = int(page_size)
+    if pg <= 0:
+        raise ValueError(f"page_size must be positive, got {page_size}")
+    if N == 0:
+        return [], {"chunks": 0, "refills": 0, "mean_slot_occupancy": 0.0,
+                    "padded_row_waste_steps": 0, "pipelined": bool(pipeline),
+                    "staged": True, "interrupted": False, "paged": True,
+                    "page_size": pg, "speculate_k": int(speculate_k),
+                    "draft_layers": int(draft_layers) if speculate_k else 0,
+                    "share_hits": 0, "share_misses": 0,
+                    "share_hit_rate": 0.0, "prompt_pool_pages": 0,
+                    "pages_in_use_peak": 0, "pages_cached": 0,
+                    "radix_nodes": 0,
+                    **PipelineGauges().as_stats(0.0, 0),
+                    **StagedGauges().as_stats(),
+                    **SpecGauges().as_stats()}
+    if trial_ids is not None and len(trial_ids) != N:
+        raise ValueError("trial_ids must align with trials")
+    H = int(trials[0].steer_vector.shape[0])
+    for t in trials:
+        if int(t.prompt_ids.shape[0]) < 1:
+            raise ValueError("paged trials need a non-empty prompt")
+        if not (1 <= t.budget <= max_new_tokens):
+            raise ValueError(
+                f"trial budget {t.budget} outside [1, {max_new_tokens}]"
+            )
+
+    speculate_k = int(speculate_k)
+    if speculate_k and not (0 < draft_layers < cfg.n_layers):
+        raise ValueError(
+            f"speculate_k={speculate_k} needs 0 < draft_layers "
+            f"< n_layers={cfg.n_layers}, got {draft_layers}"
+        )
+    geom = paged_pool_sizes(
+        trials, B, pg, max_new_tokens, speculate_k=speculate_k
+    )
+    np_max = geom["np_max"]
+    ring_w = geom["ring_width"]
+    PS = geom["decode_pages_per_slot"]
+    if speculate_k:
+        _, rounds = _spec_chunk_plan(max_new_tokens, speculate_k)
+        ch_host = rounds  # guaranteed >= 1 token per round (budget horizon)
+    else:
+        rounds = 0
+        ch_host = ring_w
+    Pp = int(prompt_pool_pages or geom["min_prompt_pages"])
+    if Pp < geom["min_prompt_pages"]:
+        raise ValueError(
+            f"prompt_pool_pages={Pp} below safe minimum "
+            f"{geom['min_prompt_pages']} for slots={B}, np_max={np_max}"
+        )
+    Pd = geom["decode_pages"]
+    Smax = np_max * pg  # widest possible staged suffix (full-prompt miss)
+
+    stop = None
+    if stop_seqs is not None and len(stop_seqs) > 0:
+        stop = jnp.asarray(np.asarray(stop_seqs, np.int32))
+    stop_width = int(stop.shape[1]) if stop is not None else 0
+    dtype = params["embed"].dtype
+    ppk, ppv, dpk, dpv = init_page_pools(
+        cfg, prompt_pages=Pp, page_size=pg, decode_pages=Pd,
+        chunk_len=ring_w, dtype=dtype,
+    )
+    mpos = jnp.zeros((B, PS * ring_w), jnp.int32)
+    mvalid = jnp.zeros((B, PS * ring_w), jnp.bool_)
+    state = SlotState(
+        prev=jnp.zeros((B,), jnp.int32),
+        done=jnp.ones((B,), jnp.bool_),
+        n_emitted=jnp.zeros((B,), jnp.int32),
+        true_len=jnp.ones((B,), jnp.int32),
+        budget=jnp.zeros((B,), jnp.int32),
+        steer_layer=jnp.zeros((B,), jnp.int32),
+        steer_strength=jnp.zeros((B,), jnp.float32),
+        steer_vectors=jnp.zeros((B, H), jnp.float32),
+        keydata=jnp.zeros((B, 2), jnp.uint32),
+        tail=jnp.full((B, stop_width), -2, jnp.int32),
+    )
+    spec = SchedSpec(
+        temperature=jnp.float32(temperature),
+        eos_ids=jnp.asarray(np.asarray(eos_ids, np.int32)),
+        pad_id=jnp.int32(pad_id),
+        stop_seqs=stop,
+    )
+    base_key = jax.random.key(seed)
+    stream_ids = (
+        jnp.arange(N) if trial_ids is None
+        else jnp.asarray(np.asarray(list(trial_ids), np.int64))
+    )
+    trial_keydata = np.asarray(
+        jax.vmap(lambda i: jax.random.key_data(jax.random.fold_in(base_key, i)))(
+            stream_ids
+        ),
+        np.uint32,
+    )
+
+    pool = PagePool(Pp)
+    tree = RadixTree(pg, pool)
+    # Host page tables: device operands rebuilt (cheap int32 copies) per
+    # dispatch. Sentinel Pp (prompt) / Pd (decode) rows clamp in the gather
+    # and are masked by true_len / mvalid. Decode pages are fixed per slot
+    # (never shared), but stay a runtime operand.
+    ptab_h = np.full((B, np_max), Pp, np.int32)
+    dtab_h = np.arange(B * PS, dtype=np.int32).reshape(B, PS)
+    dtab_j = jnp.asarray(dtab_h)
+    slot_pages: list[Optional[list[int]]] = [None] * B
+
+    slot_trial = np.full(B, -1, np.int64)
+    rem = np.zeros(B, np.int64)
+    bufs: list[list[np.ndarray]] = [[] for _ in range(N)]
+    results: list[Optional[np.ndarray]] = [None] * N
+    last_done = np.ones(B, bool)
+    pending: deque[_InFlight] = deque()
+    depth = 1 if pipeline else 0
+
+    next_trial = 0
+    g = 0
+    refills = 0
+    chunks_done = 0
+    occupancy_sum = 0.0
+    waste_steps = 0
+    share_hits = 0
+    share_misses = 0
+    pages_peak = 0
+    refill_min = max(1, int(refill_frac * B))
+    bucket_q = int(suffix_bucket)
+    gauges = PipelineGauges()
+    sgauges = StagedGauges()
+    pgauges = SpecGauges()
+    t_loop0 = time.perf_counter()
+    gauges.idle_start()
+    d_seq = 0
+    if trace is not None:
+        trace.begin(t_loop0)
+    _reg = default_registry()
+    _rl = {"replica": str(replica)}
+    m_chunks = _reg.counter(
+        "iat_scheduler_chunks_total", "decode chunks processed",
+        labelnames=("replica",))
+    m_refills = _reg.counter(
+        "iat_scheduler_refills_total", "refill/admit dispatches",
+        labelnames=("replica",))
+    m_wait = _reg.counter(
+        "iat_scheduler_host_wait_seconds_total",
+        "blocking flag-wait seconds in the host loop",
+        labelnames=("replica",))
+    m_occ = _reg.gauge(
+        "iat_scheduler_slot_occupancy",
+        "live-slot fraction at the last processed chunk",
+        labelnames=("replica",))
+    m_depth = _reg.gauge(
+        "iat_scheduler_inflight_depth",
+        "dispatches still in flight after the last harvest",
+        labelnames=("replica",))
+    m_final = _reg.counter(
+        "iat_scheduler_trials_finalized_total", "trials finalized",
+        labelnames=("replica",))
+    m_spec_acc = _reg.gauge(
+        "iat_spec_acceptance_rate",
+        "accepted/drafted ratio over processed speculative chunks",
+        labelnames=("replica",))
+    m_spec_tok = _reg.gauge(
+        "iat_spec_tokens_per_round",
+        "emitted tokens per live speculation round",
+        labelnames=("replica",))
+    c_hit = _reg.counter(
+        "iat_radix_share_hits_total",
+        "admissions whose prompt radix-matched cached prefix pages",
+        labelnames=("replica",))
+    c_miss = _reg.counter(
+        "iat_radix_share_misses_total",
+        "admissions with no cached prefix pages",
+        labelnames=("replica",))
+    g_pool_used = _reg.gauge(
+        "iat_paged_pool_pages_in_use",
+        "prompt pool pages off the free list (referenced or cached)",
+        labelnames=("replica",))
+    g_pool_cached = _reg.gauge(
+        "iat_paged_pool_pages_cached",
+        "prompt pool pages owned by the radix cache",
+        labelnames=("replica",))
+    g_share_rate = _reg.gauge(
+        "iat_paged_share_hit_rate",
+        "radix share-hit fraction over admissions so far",
+        labelnames=("replica",))
+
+    def _share_caps(t: PagedTrial) -> tuple[int, int]:
+        """(lookup_cap, insert_cap) in tokens. Steered trials only share /
+        cache KV strictly before their steering start (later positions are
+        contaminated by the injected vector); lookup additionally leaves at
+        least one suffix token so the staged pass has a first-token logit
+        row to sample from."""
+        plen = int(t.prompt_ids.shape[0])
+        safe = (
+            plen if float(t.steer_strength) == 0.0
+            else min(plen, max(0, int(t.steer_start)))
+        )
+        return min(safe, plen - 1), safe
+
+    def _pool_gauges() -> None:
+        nonlocal pages_peak
+        pages_peak = max(pages_peak, pool.in_use)
+        g_pool_used.set(float(pool.in_use), **_rl)
+        g_pool_cached.set(float(pool.cached_count), **_rl)
+        tot = share_hits + share_misses
+        if tot:
+            g_share_rate.set(share_hits / tot, **_rl)
+
+    def _dispatch_admission() -> bool:
+        """One admission wave: radix-match + allocate pages for as many
+        pending trials as there are free slots (FIFO), stage their
+        unmatched remainders in ONE bucketed ``scheduler_stage_paged``
+        dispatch, scatter them in ONE ``paged_admit`` dispatch. Returns
+        False when nothing was admitted (no free slots / queue drained /
+        pool exhausted — the caller then makes progress by decoding)."""
+        nonlocal ppk, ppv, mvalid, state, next_trial, refills, d_seq
+        nonlocal share_hits, share_misses
+        if faults is not None:
+            faults.tick("admission")
+        free = np.flatnonzero(slot_trial < 0)
+        adm: list[tuple[int, list[int], list[int], int, int]] = []
+        for _ in range(min(len(free), N - next_trial)):
+            qi = next_trial + len(adm)
+            t = trials[qi]
+            plen = int(t.prompt_ids.shape[0])
+            lookup_cap, _ = _share_caps(t)
+            matched = tree.lookup(t.prompt_ids, limit_tokens=lookup_cap)
+            h_tok = len(matched) * pg
+            n_new = -(-(plen - h_tok) // pg)
+            fresh = pool.alloc(n_new)
+            if fresh is None:
+                tree.evict(n_new - pool.free_count)
+                fresh = pool.alloc(n_new)
+            if fresh is None:
+                break  # pool pressure: admit the prefix of the wave
+            pool.retain(matched)
+            adm.append((qi, matched, fresh, h_tok, plen))
+            if matched:
+                share_hits += 1
+                c_hit.inc(**_rl)
+                ledger.event(
+                    "prefix_share_hit", trial=int(qi), prompt_len=plen,
+                    matched_tokens=h_tok, matched_pages=len(matched),
+                    fresh_pages=len(fresh),
+                )
+            else:
+                share_misses += 1
+                c_miss.inc(**_rl)
+                ledger.event(
+                    "prefix_share_miss", trial=int(qi), prompt_len=plen,
+                    fresh_pages=len(fresh),
+                )
+        if not adm:
+            if next_trial < N and not np.any(slot_trial >= 0):
+                raise RuntimeError(
+                    "paged admission deadlock: prompt page pool too small "
+                    f"({Pp} pages) for trial {next_trial}"
+                )
+            return False
+        take = len(adm)
+        n_sfx = [plen - h for (_, _, _, h, plen) in adm]
+        if bucket_q <= 0:
+            Sb = Smax
+        else:
+            Sb = min(Smax, max(1, -(-max(n_sfx) // bucket_q) * bucket_q))
+        R = min(B, 1 << max(0, (take - 1).bit_length()))
+        # Stage context width = the wave's max MATCHED pages, bucketed to a
+        # power of two (compile-count bound), not the full table. A miss
+        # wave (h_pages 0 everywhere) would otherwise attend its Sb-wide
+        # suffix over np_max*pg masked sentinel positions — doubling the
+        # prefill FLOPs of exactly the wave that has no prefix to reuse.
+        # Sentinel columns are fully masked, so slicing is bit-identical.
+        h_pg_max = max(len(m) for (_, m, _, _, _) in adm)
+        NPb = min(np_max, max(1, 1 << max(0, (h_pg_max - 1).bit_length())))
+        sfx = np.zeros((R, Sb), np.int32)
+        msk = np.zeros((R, Sb), np.int32)
+        ptab_s = np.full((R, NPb), Pp, np.int32)
+        plen_s = np.zeros(R, np.int32)
+        lay = np.zeros(R, np.int32)
+        stg = np.zeros(R, np.float32)
+        vec = np.zeros((R, H), np.float32)
+        sta = np.zeros(R, np.int32)
+        bud = np.ones(R, np.int32)
+        kd = np.zeros((R, 2), np.uint32)
+        dest = np.full((R, Sb), Pp * pg, np.int32)
+        for j, (qi, matched, fresh, h_tok, plen) in enumerate(adm):
+            t = trials[qi]
+            nr = n_sfx[j]
+            pad = Sb - nr
+            sfx[j, pad:] = t.prompt_ids[h_tok:]
+            msk[j, pad:] = 1
+            ptab_s[j, :len(matched)] = matched
+            plen_s[j] = h_tok
+            lay[j] = t.steer_layer
+            stg[j] = t.steer_strength
+            vec[j] = t.steer_vector
+            # lookup_cap <= steer_start for steered rows, so the steering
+            # start always falls inside the staged suffix window.
+            sta[j] = (
+                pad + max(0, int(t.steer_start) - h_tok)
+                if float(t.steer_strength) != 0.0 else 0
+            )
+            bud[j] = t.budget
+            kd[j] = trial_keydata[qi]
+            u = np.arange(nr, dtype=np.int64)
+            dest[j, pad:] = (
+                np.asarray(fresh, np.int64)[u // pg] * pg + u % pg
+            ).astype(np.int32)
+        budj, layj = jnp.asarray(bud), jnp.asarray(lay)
+        stgj, vecj = jnp.asarray(stg), jnp.asarray(vec)
+        (sk, sv, smask, spos, tok0, done0, true_sfx, keydata, tail0) = (
+            scheduler_stage_paged(
+                params, cfg, ppk, ppv, spec, jnp.asarray(ptab_s),
+                jnp.asarray(plen_s), jnp.asarray(sfx), jnp.asarray(msk),
+                layj, stgj, vecj, jnp.asarray(sta), budj, jnp.asarray(kd),
+            )
+        )
+        del smask, spos, true_sfx  # paged admit scatters by `dest` instead
+        sgauges.staged(take, Sb, 1, len(pending) > 0)
+        if trace is not None:
+            trace.dispatch("stage", d_seq)
+        d_seq += 1
+        slot_map = np.full(R, -1, np.int32)
+        true_ctx = np.zeros(R, np.int32)
+        for j, (qi, matched, fresh, h_tok, plen) in enumerate(adm):
+            s = int(free[j])
+            slot_map[j] = s
+            true_ctx[j] = plen
+            slot_trial[s] = qi
+            rem[s] = trials[qi].budget - 1
+            all_pages = list(matched) + list(fresh)
+            slot_pages[s] = all_pages
+            ptab_h[s] = Pp
+            ptab_h[s, :len(all_pages)] = all_pages
+        ppk, ppv, mvalid, state, tok0_b, flags = paged_admit(
+            ppk, ppv, state, spec, jnp.asarray(slot_map),
+            jnp.asarray(dest), sk, sv, tok0, done0,
+            jnp.asarray(true_ctx), budj, layj, stgj, vecj, keydata, tail0,
+            mvalid,
+        )
+        flags.copy_to_host_async()
+        tok0_b.copy_to_host_async()
+        pending.append(_InFlight("refill", flags, tok0_b, slot_trial.copy(),
+                                 d_seq))
+        if trace is not None:
+            trace.dispatch("refill", d_seq)
+        d_seq += 1
+        m_refills.inc(**_rl)
+        gauges.dispatched(len(pending))
+        sgauges.admitted()
+        # Cache the steer-free full pages for future radix hits. The admit
+        # scatter above is already enqueued, so any later stage that shares
+        # these pages is ordered after the KV lands (one device stream).
+        for (qi, matched, fresh, h_tok, plen) in adm:
+            _, insert_cap = _share_caps(trials[qi])
+            tree.insert(
+                trials[qi].prompt_ids, list(matched) + list(fresh),
+                limit_tokens=insert_cap,
+            )
+        _pool_gauges()
+        next_trial += take
+        refills += 1
+        return True
+
+    def _dispatch_chunk() -> None:
+        nonlocal dpk, dpv, mpos, mvalid, state, g, d_seq
+        ptab_j = jnp.asarray(ptab_h)
+        if speculate_k:
+            dpk, dpv, mpos, mvalid, state, toks, flags = (
+                paged_decode_chunk_speculate(
+                    params, cfg, ppk, ppv, dpk, dpv, mpos, mvalid, state,
+                    spec, ptab_j, dtab_j,
+                    rounds=rounds, k=speculate_k, draft_layers=draft_layers,
+                )
+            )
+        else:
+            page = jnp.int32(g % PS) if PS else jnp.int32(0)
+            dpk, dpv, mpos, mvalid, state, toks, flags = paged_decode_chunk(
+                params, cfg, ppk, ppv, dpk, dpv, mpos, mvalid, state, spec,
+                ptab_j, dtab_j, page, ch=ring_w,
+            )
+        g += 1
+        flags.copy_to_host_async()
+        toks.copy_to_host_async()
+        pending.append(_InFlight("chunk", flags, toks, slot_trial.copy(),
+                                 d_seq))
+        if trace is not None:
+            trace.dispatch("chunk", d_seq)
+        d_seq += 1
+        gauges.dispatched(len(pending))
+        assigned = slot_trial >= 0
+        rem[assigned] = np.maximum(rem[assigned] - ch_host, 0)
+
+    def _process_one() -> None:
+        nonlocal occupancy_sum, waste_steps, chunks_done, last_done
+        ev = pending.popleft()
+        t0 = time.perf_counter()
+        flags = np.asarray(ev.flags)
+        toks = np.asarray(ev.toks)
+        wait_s = time.perf_counter() - t0
+        gauges.waited(wait_s)
+        m_wait.inc(wait_s, **_rl)
+        if trace is not None:
+            trace.landed(ev.kind, ev.seq, t0, t0 + wait_s)
+        done = flags[:B] != 0
+        n_em = flags[B : 2 * B]
+        if ev.kind == "chunk":
+            live = int(((ev.owners >= 0) & ~last_done).sum())
+            occupancy_sum += live / B
+            waste_steps += (B - live) * ch_host
+            chunks_done += 1
+            m_chunks.inc(**_rl)
+            m_occ.set(live / B, **_rl)
+            cnt = None
+            if speculate_k:
+                cnt = flags[2 * B : 3 * B]
+                acc, drf = int(flags[3 * B]), int(flags[3 * B + 1])
+                pgauges.chunk(acc, drf, int(cnt.sum()), drf // speculate_k)
+                if pgauges.drafted:
+                    m_spec_acc.set(
+                        pgauges.accepted / pgauges.drafted, **_rl)
+                if pgauges.live_rounds:
+                    m_spec_tok.set(
+                        pgauges.emitted / pgauges.live_rounds, **_rl)
+            for s in range(B):
+                ti = int(ev.owners[s])
+                if ti >= 0 and results[ti] is None:
+                    bufs[ti].append(
+                        toks[s, : int(cnt[s])] if cnt is not None else toks[s]
+                    )
+            ledger.event(
+                "slot_occupancy",
+                chunk=chunks_done,
+                occupied=int(live),
+                slots=int(B),
+                frac=round(live / B, 4),
+                padded_waste_steps_total=int(waste_steps),
+                host_wait_ms=round(1e3 * wait_s, 3),
+                inflight_depth=len(pending),
+                pool_pages_in_use=int(pool.in_use),
+            )
+        else:  # refill: tok0 seeds each just-admitted trial's buffer
+            for s in range(B):
+                ti = int(ev.owners[s])
+                if ti >= 0 and results[ti] is None and not bufs[ti]:
+                    bufs[ti].append(toks[s : s + 1])
+        for s in range(B):
+            ti = int(ev.owners[s])
+            if ti >= 0 and results[ti] is None and done[s]:
+                toks_all = (
+                    np.concatenate(bufs[ti]) if bufs[ti]
+                    else np.zeros(0, np.int32)
+                )
+                results[ti] = toks_all[: int(n_em[s])]
+                bufs[ti] = []
+                if slot_trial[s] == ti:
+                    slot_trial[s] = -1
+                    rem[s] = 0
+                    if slot_pages[s] is not None:
+                        # Drop this tenancy's references; radix-cached
+                        # pages survive at refcount 0, the rest return to
+                        # the free list (the dedup "free on harvest").
+                        pool.release(slot_pages[s])
+                        slot_pages[s] = None
+                        _pool_gauges()
+                m_final.inc(**_rl)
+                if result_cb is not None:
+                    result_cb(ti, results[ti])
+        last_done = done
+        m_depth.set(len(pending), **_rl)
+        if trace is not None:
+            trace.processed(ev.kind, ev.seq)
+        if not pending:
+            gauges.idle_start()
+        if faults is not None and ev.kind == "chunk":
+            faults.tick("chunk")
+
+    interrupted = False
+    while True:
+        if stop_event is not None and stop_event.is_set():
+            while pending:
+                _process_one()
+            interrupted = True
+            break
+        while len(pending) > depth:
+            _process_one()
+        free_cnt = int((slot_trial < 0).sum())
+        n_live_known = B - free_cnt
+        if next_trial < N and (free_cnt >= refill_min or n_live_known == 0):
+            if _dispatch_admission():
+                # Same reason as the classic refill's `continue`: surface
+                # first-token finishes before burning a chunk.
+                continue
+        if n_live_known == 0:
+            while pending:
+                _process_one()
+            if int((slot_trial < 0).sum()) == B and next_trial >= N:
+                break
+            continue
+        if pending and not np.any((slot_trial >= 0) & (rem > 0)):
+            _process_one()
+            continue
+        _dispatch_chunk()
+
+    if not interrupted:
+        assert all(r is not None for r in results)
+    wall_s = time.perf_counter() - t_loop0
+    tot = share_hits + share_misses
+    stats = {
+        "chunks": g,
+        "refills": refills,
+        "mean_slot_occupancy": (
+            round(occupancy_sum / chunks_done, 4) if chunks_done else 1.0
+        ),
+        "padded_row_waste_steps": int(waste_steps),
+        "pipelined": bool(pipeline),
+        "staged": True,
+        "interrupted": bool(interrupted),
+        "paged": True,
+        "page_size": pg,
+        "speculate_k": int(speculate_k),
+        "draft_layers": int(draft_layers) if speculate_k else 0,
+        "share_hits": int(share_hits),
+        "share_misses": int(share_misses),
+        "share_hit_rate": round(share_hits / tot, 4) if tot else 0.0,
+        "prompt_pool_pages": int(Pp),
+        "pages_in_use_peak": int(pages_peak),
+        "pages_cached": int(pool.cached_count),
+        "radix_nodes": int(tree.n_nodes),
         **gauges.as_stats(wall_s, chunks_done),
         **sgauges.as_stats(),
         **pgauges.as_stats(),

@@ -1,0 +1,249 @@
+"""Paged KV cache + radix prefix sharing: bit-identity with the classic
+three-tier cache across page sizes, slot counts, and speculative decode;
+page-pool refcount / free-on-harvest / LRU-eviction semantics; and the
+divergent-suffix queue class (no queue-wide common prefix) that previously
+fell back to fixed batches now running scheduled."""
+
+import jax
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu import obs
+from introspective_awareness_tpu.models import (
+    ByteTokenizer,
+    init_params,
+    tiny_config,
+)
+from introspective_awareness_tpu.runtime import ModelRunner
+from introspective_awareness_tpu.runtime.radix import PagePool, RadixTree
+from introspective_awareness_tpu.runtime.scheduler import (
+    PagedTrial,
+    TrialRequest,
+    paged_pool_sizes,
+    run_scheduled,
+    run_scheduled_paged,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+COMMON = "The quick brown fox jumps over the lazy dog. " * 2
+
+
+def _queues(cfg, n=5, max_new=12):
+    """The shared-prefix queue of test_scheduler, expressed BOTH ways:
+    classic (prefix + padded suffixes) and paged (full unpadded prompts,
+    steer starts in prompt coords). Ragged suffixes, a strength-0 row every
+    third trial, per-trial budgets with stragglers."""
+    tok = ByteTokenizer()
+    prefix = np.asarray(tok.encode(COMMON), np.int32)
+    p0 = len(prefix)
+    rng = np.random.default_rng(7)
+    suffixes, layers, strengths, starts, vecs = [], [], [], [], []
+    for i in range(n):
+        s = f"Trial {i + 1}: Do you detect an injected thought" + "?" * (i % 3 + 1)
+        sfx = np.asarray(tok.encode_plain(s), np.int32)
+        suffixes.append(sfx)
+        layers.append(1 + i % 2)
+        if i % 3 == 2:
+            strengths.append(0.0)
+            starts.append(0)
+        else:
+            strengths.append(6.0 + i)
+            starts.append(len(sfx) - 5)
+        vecs.append(rng.standard_normal(cfg.hidden_size).astype(np.float32) * 4.0)
+    ss = max(len(s) for s in suffixes)
+    budgets = [max_new, 5, max_new, 8, max_new][:n]
+    classic, paged = [], []
+    for i in range(n):
+        sfx = suffixes[i]
+        pad = ss - len(sfx)
+        ids = np.full(ss, tok.pad_id, np.int32)
+        msk = np.zeros(ss, np.int32)
+        ids[pad:] = sfx
+        msk[pad:] = 1
+        classic.append(TrialRequest(
+            suffix_ids=ids, suffix_mask=msk, steer_layer=layers[i],
+            steer_strength=strengths[i], steer_vector=vecs[i],
+            steer_start=pad + starts[i] if strengths[i] else 0,
+            budget=budgets[i],
+        ))
+        paged.append(PagedTrial(
+            prompt_ids=np.concatenate([prefix, sfx]).astype(np.int32),
+            steer_layer=layers[i], steer_strength=strengths[i],
+            steer_vector=vecs[i],
+            steer_start=p0 + starts[i] if strengths[i] else 0,
+            budget=budgets[i],
+        ))
+    return prefix, classic, paged
+
+
+@pytest.mark.parametrize("slots", [2, 4])
+@pytest.mark.parametrize("speculate_k", [0, 3])
+def test_paged_matches_classic_cache(setup, slots, speculate_k):
+    """Bit-identity is the invariant: for greedy AND sampled decoding, the
+    paged cache must reproduce the classic scheduler's tokens byte-for-byte
+    at every page size — page geometry is an execution detail that may not
+    leak into text. Speculative decode rides the same check (the paged fold
+    feeds the verify pass)."""
+    cfg, params = setup
+    prefix, classic, paged = _queues(cfg)
+    kw = dict(
+        slots=slots, max_new_tokens=12, eos_ids=ByteTokenizer().eos_ids,
+        pad_id=ByteTokenizer().pad_id, seed=0, speculate_k=speculate_k,
+        draft_layers=2 if speculate_k else 0,
+    )
+    for temp in (0.0, 0.9):
+        ref, _ = run_scheduled(
+            params, cfg, prefix, classic, temperature=temp, **kw)
+        for pg in (8, 16, 64):
+            got, stats = run_scheduled_paged(
+                params, cfg, paged, page_size=pg, temperature=temp, **kw)
+            assert stats["paged"] is True
+            for i, (a, b) in enumerate(zip(ref, got)):
+                assert np.array_equal(a, b), (
+                    f"trial {i} diverged (pg={pg}, temp={temp}): "
+                    f"{a.tolist()} vs {b.tolist()}"
+                )
+
+
+def test_shared_prefix_dedup_and_free_on_harvest(setup):
+    """Radix admission on a shared-prefix queue: every trial after the
+    first radix-hits the common preamble (FLOP-free page-table edit), the
+    in-use peak stays bounded by the resident slots (harvest releases a
+    slot's references; dedup means shared pages are counted once), and the
+    ledger carries the per-trial share events."""
+    cfg, params = setup
+    _, _, paged = _queues(cfg)
+    led = obs.RunLedger()
+    geom = paged_pool_sizes(paged, 2, 8, 12)
+    _, stats = run_scheduled_paged(
+        params, cfg, paged, slots=2, max_new_tokens=12, page_size=8,
+        eos_ids=ByteTokenizer().eos_ids, pad_id=ByteTokenizer().pad_id,
+        seed=0, ledger=led,
+    )
+    # 5 trials, first-of-prefix misses, the rest hit the cached preamble.
+    assert stats["share_misses"] >= 1
+    assert stats["share_hits"] >= 3
+    assert stats["share_hit_rate"] == pytest.approx(
+        stats["share_hits"] / 5)
+    hits = [e for e in led.events
+            if e.get("ev") == "event" and e.get("name") == "prefix_share_hit"]
+    assert len(hits) == stats["share_hits"]
+    assert all(e["matched_pages"] > 0 for e in hits)
+    # Free-on-harvest + dedup: even with 5 trials through 2 slots, the pool
+    # never holds more than the minimum-safe resident set (every slot full
+    # plus one admission) — a leak or a per-trial copy would blow past it.
+    assert stats["pages_in_use_peak"] <= geom["min_prompt_pages"]
+    assert stats["pages_cached"] > 0
+    assert stats["radix_nodes"] > 0
+
+
+def test_page_pool_refcount_lifecycle():
+    """Pool invariants the scheduler leans on: all-or-nothing alloc, shared
+    pages survive their first release (refcount), cached pages survive
+    refcount 0 (the tree owns them), and uncache frees exactly the
+    unreferenced ones."""
+    pool = PagePool(4)
+    pages = pool.alloc(3)
+    assert sorted(pages) == [0, 1, 2] and pool.free_count == 1
+    assert pool.alloc(2) is None, "over-alloc must fail atomically"
+    assert pool.free_count == 1, "failed alloc must not leak pages"
+    # Second trial shares page 0 and 1.
+    pool.retain(pages[:2])
+    assert pool.release(pages) == [pages[2]]  # shared pages still held
+    assert pool.release(pages[:2]) == pages[:2]
+    assert pool.free_count == 4
+    # Cached pages stay resident at refcount 0 until uncache.
+    (p,) = pool.alloc(1)
+    pool.mark_cached(p)
+    assert pool.release([p]) == []
+    assert pool.in_use == 1 and pool.cached_count == 1
+    assert pool.uncache(p) is True
+    assert pool.free_count == 4
+    # uncache of a still-referenced page must NOT free it.
+    (q,) = pool.alloc(1)
+    pool.mark_cached(q)
+    assert pool.uncache(q) is False
+    assert pool.release([q]) == [q]
+
+
+def test_radix_tree_share_and_lru_evict():
+    """Tree semantics: lookup returns the longest cached FULL-page prefix,
+    insert is collision-stable (existing nodes win), and eviction is LRU
+    leaf-first, skipping pages a slot still references."""
+    pool = PagePool(8)
+    tree = RadixTree(2, pool)
+    a = pool.alloc(3)
+    assert tree.insert([1, 2, 3, 4, 5, 6], a) == 3
+    pool.release(a)  # harvest: cached pages stay resident
+    assert pool.in_use == 3
+    # Full-page prefix match only; the 5-token lookup matches 2 pages.
+    assert tree.lookup([1, 2, 3, 4, 9]) == a[:2]
+    assert tree.lookup([9, 9]) == []
+    # Collision: re-inserting the same chunks caches nothing new.
+    b = pool.alloc(2)
+    assert tree.insert([1, 2, 3, 4], b) == 0
+    pool.release(b)
+    assert pool.free_count == 8 - 3
+    # A second branch, then LRU eviction: branch [7,8] is older than the
+    # just-looked-up [1..6] path, so it must go first, leaves before roots.
+    c = pool.alloc(1)
+    assert tree.insert([7, 8], c) == 1
+    pool.release(c)
+    tree.lookup([1, 2, 3, 4, 5, 6])  # bump the long path's clocks
+    assert tree.evict(1) == 1
+    assert pool.cached[c[0]] is False and tree.n_nodes == 3
+    # Referenced pages are not evictable even when cached.
+    held = tree.lookup([1, 2, 3, 4, 5, 6])
+    pool.retain(held)
+    assert tree.evict(99) == 0, "evicted pages a slot still reads"
+    pool.release(held)
+    assert tree.evict(99) == 3, "leaf-first eviction should drain the path"
+    assert pool.free_count == 8
+
+
+def test_divergent_queue_runs_scheduled(setup):
+    """The queue class that USED to hit the fixed-batch fallback — no
+    queue-wide common prefix, just per-family shareable preambles — must
+    now run on the paged scheduler (a fallback here is a test failure),
+    with radix sharing firing and greedy text identical to the fallback
+    path (kv_paged='off')."""
+    cfg, params = setup
+    led = obs.RunLedger()
+    paged_runner = ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4, ledger=led,
+    )
+    off_runner = ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4, kv_paged="off",
+    )
+    fams = ["Family Alpha protocol: " + "x" * 30 + " ",
+            "Family Beta protocol: " + "y" * 30 + " "]
+    prompts = [fams[i % 2] + f"trial {i} diverges here {i}" for i in range(6)]
+    rng = np.random.default_rng(3)
+    vecs = [rng.standard_normal(cfg.hidden_size).astype(np.float32) * 4.0
+            for _ in prompts]
+    layers = [1 + i % 2 for i in range(6)]
+    strengths = [0.0 if i % 3 == 2 else 5.0 + i for i in range(6)]
+    starts = [None if i % 3 == 2 else len(prompts[i]) - 8 for i in range(6)]
+    kw = dict(max_new_tokens=10, temperature=0.0,
+              steering_start_positions=starts, seed=0, slots=2)
+    got = paged_runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, **kw)
+    spans = [s for s in led.spans() if s["phase"] == "generate_scheduled"]
+    assert spans and spans[-1].get("paged") is True, (
+        "shareable divergent-suffix queue fell back to the fixed-batch path"
+    )
+    assert spans[-1].get("share_hits", 0) > 0, (
+        "per-family preambles never radix-hit"
+    )
+    ref = off_runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, **kw)
+    assert got == ref

@@ -208,11 +208,19 @@ def test_filler_rows_emit_only_pad(runner_noprefix, monkeypatch):
     assert (toks[3] == pad).all(), "filler row decoded real tokens"
 
 
-def test_scheduler_fallback_is_batch_path(runner):
-    """No shared prefix => the continuous path falls back to fixed batches:
-    uniform budgets produce the batch path's exact output, and a
-    mixed-budget queue is served by grouping trials per budget (one batch
-    call per group — see test_staged_prefill for the row-level check)."""
+def test_scheduler_fallback_is_batch_path(setup):
+    """With the paged cache disabled (``kv_paged="off"``), no shared prefix
+    => the continuous path falls back to fixed batches: uniform budgets
+    produce the batch path's exact output, and a mixed-budget queue is
+    served by grouping trials per budget (one batch call per group — see
+    test_staged_prefill for the row-level check). Under the default
+    ``kv_paged="auto"`` this queue class runs scheduled instead — see
+    test_paged_kv.test_divergent_queue_runs_scheduled."""
+    cfg, params = setup
+    runner = ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4, kv_paged="off",
+    )
     prompts = ["Alpha prompt one", "Beta prompt two", "Gamma prompt three"]
     rng = np.random.default_rng(3)
     vecs = [rng.standard_normal(runner.cfg.hidden_size).astype(np.float32)

@@ -182,15 +182,18 @@ def test_sampled_distribution_matches_full_model(runner):
 
 
 def test_no_shared_prefix_falls_back_and_ledgers(setup):
-    """A queue with no common token prefix cannot speculate (the slot
-    scheduler itself is prefix-keyed): the runner must fall back to the
-    fixed-batch path, emit ``speculation_unavailable_fallback``, and still
-    return the batch path's exact text."""
+    """With the paged cache disabled, a queue with no common token prefix
+    cannot speculate (the CLASSIC slot scheduler is prefix-keyed): the
+    runner must fall back to the fixed-batch path, emit
+    ``speculation_unavailable_fallback``, and still return the batch path's
+    exact text. (Under the default ``kv_paged="auto"`` this queue now
+    speculates on the paged scheduler — covered by test_paged_kv's
+    equivalence matrix.)"""
     cfg, params = setup
     ledger = obs.RunLedger()
     runner = ModelRunner(
         params, cfg, ByteTokenizer(), model_name="tiny-fb",
-        seq_multiple=16, batch_multiple=4, ledger=ledger,
+        seq_multiple=16, batch_multiple=4, ledger=ledger, kv_paged="off",
     )
     prompts = [
         "Alpha prompt, nothing shared here at all.",

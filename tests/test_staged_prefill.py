@@ -191,10 +191,17 @@ def test_staged_stats_preserved(setup):
 
 
 @pytest.mark.slow  # fallback equivalence also covered by test_scheduler fallback
-def test_fallback_budget_grouping_matches_batch(runner):
-    """No shared prefix => the scheduler falls back to fixed batches. With
-    mixed budgets it must group trials by budget and match per-budget
-    generate_batch_with_grid_steering calls row-for-row (greedy)."""
+def test_fallback_budget_grouping_matches_batch(setup):
+    """With the paged cache disabled, no shared prefix => the scheduler
+    falls back to fixed batches. With mixed budgets it must group trials by
+    budget and match per-budget generate_batch_with_grid_steering calls
+    row-for-row (greedy). (Under the default ``kv_paged="auto"`` this queue
+    runs on the paged scheduler instead — see test_paged_kv.)"""
+    cfg, params = setup
+    runner = ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4, kv_paged="off",
+    )
     hidden = runner.cfg.hidden_size
     prompts = [f"Totally distinct prompt number {i}!" * (i + 1)
                for i in range(5)]
