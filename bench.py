@@ -1115,6 +1115,86 @@ def _fabric_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     return r
 
 
+def _serving_compare(runner, cfg, tok, slots, max_new, ledger,
+                     duration_s: float = 10.0) -> dict:
+    """Persistent steering service under concurrent two-tenant load.
+
+    Boots the full serving stack in-process — ServeEngine (feed-mode
+    continuous scheduler over the shared slot pool) behind a real
+    loopback ``ServeServer`` — and drives it with ``serve.loadgen``:
+    closed-loop interactive clients racing an open-arrival bulk tenant,
+    heavy-tailed prompt lengths. The section reports client-observed
+    TTFT/ITL percentiles (the SLO the preemption policy exists to
+    protect), the server-side histogram readbacks, quota 429s, and the
+    headline ``serving_goodput_evals_per_s`` — completed requests per
+    wall second across both tenants, which perf_gate tracks. One warm
+    request runs before the timed window so JIT compile cost lands in
+    the ledger's compile accounting, not the latency histograms.
+    """
+    import queue as _queue
+
+    from introspective_awareness_tpu.obs.registry import MetricsRegistry
+    from introspective_awareness_tpu.serve.engine import ServeEngine
+    from introspective_awareness_tpu.serve.loadgen import run_loadgen
+    from introspective_awareness_tpu.serve.request import SteerRequest
+    from introspective_awareness_tpu.serve.server import ServeServer
+    from introspective_awareness_tpu.serve.tenants import TenantTable
+
+    reg = MetricsRegistry()
+    eng = ServeEngine(
+        runner, slots=slots, max_new_tokens=max_new, max_prompt_len=512,
+        temperature=0.0, seed=11, preempt_after_s=0.2,
+        tenants=TenantTable(
+            max_inflight=2 * slots, max_queued=4 * slots,
+            known_tenants=("chat", "sweep"), registry=reg,
+        ),
+        registry=reg, replica="bench-serve",
+    ).start()
+    srv = ServeServer(eng, port=0, registry=reg).start()
+    try:
+        warm = eng.submit(SteerRequest(
+            rid="warm", tenant="chat", priority="interactive",
+            prompt="warm the decode path", vector="demo", layer=1,
+            strength=2.0, steer_start=0, max_new_tokens=4, temperature=0.0,
+        ))
+        while True:
+            try:
+                doc = warm.q.get(timeout=600)
+            except _queue.Empty:
+                raise RuntimeError("serving warmup wedged") from None
+            if doc.get("done") or "error" in doc:
+                break
+        summary = run_loadgen(
+            "127.0.0.1", srv.port, duration_s=duration_s,
+            interactive_clients=2, bulk_rate_hz=max(1.0, slots / 2.0),
+            seed=7, vector="demo", layer=int(cfg.n_layers * 0.6),
+            strength=4.0, interactive_max_new=min(8, max_new),
+            bulk_max_new=max_new,
+        )
+    finally:
+        srv.stop()
+        stats = eng.close()
+    r = {
+        **summary,
+        "slots": slots,
+        "scheduler_preempted": stats.get("preempted"),
+        # Server-side SLO readback (the /metrics view of the same run).
+        "ttft_p50_server_s": eng._h_ttft.quantile(0.5, priority="interactive"),
+        "ttft_p99_server_s": eng._h_ttft.quantile(0.99, priority="interactive"),
+        "itl_p50_server_s": eng._h_itl.quantile(0.5, priority="interactive"),
+        "rejected_chat": reg.value("iat_serve_rejected_total", tenant="chat"),
+        "rejected_sweep": reg.value("iat_serve_rejected_total", tenant="sweep"),
+    }
+    log(
+        f"  [serving] {r['completed_interactive']}i+{r['completed_bulk']}b "
+        f"done in {r['duration_s']}s, goodput "
+        f"{r['serving_goodput_evals_per_s']} evals/s, ttft p50/p99 "
+        f"{r['ttft_p50_s']}/{r['ttft_p99_s']}s, itl p50 {r['itl_p50_s']}s, "
+        f"429s={r['rejected_429']}, preempted={r['scheduler_preempted']}"
+    )
+    return r
+
+
 def _coordinator_rpc_bench(n_trials: int = 512, lease_size: int = 8) -> dict:
     """Control-plane microbench: in-process queue vs the RPC coordinator.
 
@@ -1582,6 +1662,16 @@ def main() -> None:
         ledger,
     )
 
+    # ---- steering-as-a-service: two-tenant load over the HTTP front-end ----
+    srv = _gated(
+        "serving",
+        lambda: _serving_compare(
+            runner, cfg, tok, batches[0], max_new, ledger,
+            duration_s=15.0 if on_tpu else 8.0,
+        ),
+        ledger,
+    )
+
     # ---- multi-host control plane: local vs RPC vs RPC+WAL queue drain -----
     try:
         coord = _coordinator_rpc_bench()
@@ -1871,6 +1961,7 @@ def main() -> None:
         "staged_prefill": stg,
         "durability": dur,
         "fabric": fab,
+        "serving": srv,
         "coordinator_rpc": coord,
         "prefill_memory": pmem,
         "trace": trace_block,

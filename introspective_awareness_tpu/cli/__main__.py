@@ -1,6 +1,10 @@
 import sys
 
-from introspective_awareness_tpu.cli.sweep import main
-
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        from introspective_awareness_tpu.cli.serve import main
+
+        sys.exit(main(sys.argv[2:]))
+    from introspective_awareness_tpu.cli.sweep import main
+
     sys.exit(main())

@@ -1,0 +1,167 @@
+"""``serve`` entrypoint: the long-lived steering service process.
+
+Boot: load the model, open (or resume) the request journal, recover any
+accepted-but-unfinished requests, start the scheduler thread and the
+HTTP front door, and print the bound port. Shutdown is the serving
+counterpart of the sweep's preemption path — but graceful: SIGTERM (or
+SIGINT) drains in-flight requests to completion, leaves queued-but-
+unstarted ones journaled for the next boot, flushes the metrics snapshot
+into ``run_manifest.json``, and exits 0 (the sweep's exit-130 path means
+"requeue me"; a drained server is DONE).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="serve", description="steering-as-a-service front-end"
+    )
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = ephemeral; the bound port is printed")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--max-prompt-len", type=int, default=512)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--preempt-after-s", type=float, default=0.25,
+                   help="interactive queue wait that triggers preemption")
+    p.add_argument("--quota-inflight", type=int, default=8)
+    p.add_argument("--quota-queued", type=int, default=16)
+    p.add_argument("--tenants", default="chat,sweep",
+                   help="comma list reserved in metric label space")
+    p.add_argument("--journal", default="auto",
+                   help="'off', 'auto' (under --output-dir), or a path")
+    p.add_argument("--output-dir", default="serve_out")
+    p.add_argument("--dtype", default="float32",
+                   choices=["bfloat16", "float16", "float32"])
+    p.add_argument("--quantization", default=None,
+                   choices=[None, "8bit", "4bit"])
+    p.add_argument("--attn-impl", default="xla")
+    p.add_argument("--kv-cache-dtype", default="model")
+    p.add_argument("--max-wall-s", type=float, default=0.0,
+                   help="self-terminate after this many seconds (tests)")
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    from introspective_awareness_tpu.cli.sweep import load_subject
+    from introspective_awareness_tpu.obs.http import HealthState
+    from introspective_awareness_tpu.obs.registry import default_registry
+    from introspective_awareness_tpu.serve.engine import ServeEngine
+    from introspective_awareness_tpu.serve.server import ServeServer
+    from introspective_awareness_tpu.serve.tenants import TenantTable
+
+    out_dir = Path(args.output_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    registry = default_registry()
+    runner = load_subject(args.model, args, mesh=None, rules=None)
+
+    journal = None
+    if args.journal != "off":
+        from introspective_awareness_tpu.runtime.journal import TrialJournal
+
+        path = (
+            out_dir / "request_journal.jsonl" if args.journal == "auto"
+            else Path(args.journal)
+        )
+        journal = TrialJournal(path, {
+            "kind": "serve",
+            "model": args.model,
+            "seed": int(args.seed),
+            "temperature": float(args.temperature),
+            "max_new_tokens": int(args.max_new_tokens),
+        })
+
+    known = [t for t in str(args.tenants).split(",") if t]
+    engine = ServeEngine(
+        runner,
+        slots=args.slots,
+        max_new_tokens=args.max_new_tokens,
+        max_prompt_len=args.max_prompt_len,
+        temperature=args.temperature,
+        seed=args.seed,
+        preempt_after_s=args.preempt_after_s,
+        tenants=TenantTable(
+            max_inflight=args.quota_inflight,
+            max_queued=args.quota_queued,
+            known_tenants=known,
+            registry=registry,
+        ),
+        journal=journal,
+        registry=registry,
+    )
+    n_recovered = engine.recover()
+    engine.start()
+
+    health = HealthState()
+    if journal is not None:
+        health.add_probe(
+            "journal_fsync",
+            lambda: "fsync failing" if journal.fsync_failed else None,
+        )
+    health.add_probe(
+        "scheduler",
+        lambda: ("crashed" if engine._loop_error is not None else None),
+    )
+    server = ServeServer(
+        engine, port=args.port, host=args.host,
+        registry=registry, health=health,
+    ).start()
+
+    stop = threading.Event()
+
+    def _graceful(signum, frame) -> None:
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+
+    print(f"serving on {server.url} (recovered={n_recovered})", flush=True)
+    t0 = time.monotonic()
+    while not stop.wait(0.25):
+        if engine._loop_error is not None:
+            break
+        if args.max_wall_s and time.monotonic() - t0 > args.max_wall_s:
+            break
+
+    # Graceful drain: running requests finish, queued ones stay journaled.
+    server.stop()  # stop admitting first — no new requests mid-drain
+    crashed = False
+    try:
+        stats = engine.close()
+    except RuntimeError:
+        crashed = True
+        stats = dict(engine.stats)
+    if journal is not None:
+        journal.record_clean_stop()
+        journal.close()
+    manifest = {
+        "kind": "serve",
+        "model": args.model,
+        "clean_shutdown": not crashed,
+        "recovered_requests": int(n_recovered),
+        "scheduler_stats": stats,
+        "metrics": registry.snapshot(),
+    }
+    (out_dir / "run_manifest.json").write_text(
+        json.dumps(manifest, indent=2, default=str)
+    )
+    print(f"drained; manifest at {out_dir / 'run_manifest.json'}", flush=True)
+    return 1 if crashed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

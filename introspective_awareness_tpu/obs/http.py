@@ -224,6 +224,51 @@ def _progress_doc(registry: MetricsRegistry,
     return doc
 
 
+def send_http(handler: BaseHTTPRequestHandler, code: int, ctype: str,
+              body: bytes, extra_headers: Optional[dict] = None) -> None:
+    """Write one complete (non-chunked) HTTP response on a stdlib handler."""
+    handler.send_response(code)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(body)))
+    for k, v in (extra_headers or {}).items():
+        handler.send_header(k, str(v))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def handle_observability_get(
+    handler: BaseHTTPRequestHandler,
+    path: str,
+    registry: MetricsRegistry,
+    progress: Optional[ProgressTracker],
+    health: HealthState,
+) -> bool:
+    """Serve the shared observability GET routes (``/metrics``,
+    ``/progress``, ``/registry``, ``/healthz``) on any stdlib handler.
+    Returns False when ``path`` is not an observability route, so callers
+    (e.g. the serving front-end, which multiplexes these onto its request
+    port) can fall through to their own routing."""
+    if path == "/metrics":
+        send_http(handler, 200, PROM_CONTENT_TYPE,
+                  registry.render_prometheus().encode())
+    elif path == "/progress":
+        send_http(handler, 200, "application/json",
+                  json.dumps(_progress_doc(registry, progress)).encode())
+    elif path == "/registry":
+        send_http(handler, 200, "application/json",
+                  json.dumps(registry.snapshot()).encode())
+    elif path == "/healthz":
+        reasons = health.reasons()
+        if reasons:
+            body = "degraded: " + "; ".join(reasons) + "\n"
+            send_http(handler, 503, "text/plain", body.encode())
+        else:
+            send_http(handler, 200, "text/plain", b"ok\n")
+    else:
+        return False
+    return True
+
+
 class MetricsServer:
     """ThreadingHTTPServer wrapper behind ``--metrics-port``."""
 
@@ -266,24 +311,9 @@ class MetricsServer:
 
             def do_GET(self) -> None:
                 path = self.path.split("?", 1)[0]
-                if path == "/metrics":
-                    self._send(200, PROM_CONTENT_TYPE,
-                               registry.render_prometheus().encode())
-                elif path == "/progress":
-                    self._send(200, "application/json",
-                               json.dumps(_progress_doc(
-                                   registry, progress)).encode())
-                elif path == "/registry":
-                    self._send(200, "application/json",
-                               json.dumps(registry.snapshot()).encode())
-                elif path == "/healthz":
-                    reasons = health.reasons()
-                    if reasons:
-                        body = ("degraded: " + "; ".join(reasons) + "\n")
-                        self._send(503, "text/plain", body.encode())
-                    else:
-                        self._send(200, "text/plain", b"ok\n")
-                else:
+                if not handle_observability_get(
+                    self, path, registry, progress, health
+                ):
                     self._send(404, "text/plain", b"not found\n")
 
         self._httpd = ThreadingHTTPServer(
@@ -318,4 +348,6 @@ __all__ = [
     "MetricsServer",
     "ProgressTracker",
     "PROM_CONTENT_TYPE",
+    "handle_observability_get",
+    "send_http",
 ]

@@ -151,6 +151,36 @@ class Gauge(_Metric):
             return float(self._series.get(self._key(labels), 0.0))
 
 
+def bucket_quantile(buckets: Sequence[float], counts: Sequence[int],
+                    q: float) -> Optional[float]:
+    """Prometheus-style quantile estimate from cumulative-free bucket counts.
+
+    ``buckets`` are the finite upper bounds, ``counts`` the PER-BUCKET
+    (non-cumulative) observation counts with the +Inf count last — exactly
+    a :class:`Histogram` cell minus its trailing sum. The q-th quantile
+    (0 <= q <= 1) is located by rank and linearly interpolated inside its
+    bucket (lower edge 0 for the first). Observations in the +Inf bucket
+    clamp to the largest finite bound — the estimate is a floor there, the
+    same convention ``histogram_quantile`` uses. Returns None for an empty
+    histogram."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    lo = 0.0
+    for b, c in zip(buckets, counts):
+        if c:
+            if cum + c >= rank:
+                frac = min(1.0, max(0.0, (rank - cum) / c))
+                return lo + (float(b) - lo) * frac
+            cum += c
+        lo = float(b)
+    return float(buckets[-1]) if buckets else None
+
+
 class Histogram(_Metric):
     kind = "histogram"
 
@@ -176,6 +206,21 @@ class Histogram(_Metric):
             else:
                 cell[len(self.buckets)] += 1
             cell[-1] += value
+
+    def quantile(self, q: float, **labels: Any) -> Optional[float]:
+        """Estimated q-th quantile of one series (None when unobserved);
+        serving SLO readouts (TTFT/ITL p50/p99) use this directly."""
+        with self._lock:
+            cell = self._series.get(self._key(labels))
+            counts = None if cell is None else list(cell[:-1])
+        if counts is None:
+            return None
+        return bucket_quantile(self.buckets, counts, q)
+
+    def count(self, **labels: Any) -> int:
+        with self._lock:
+            cell = self._series.get(self._key(labels))
+            return 0 if cell is None else int(sum(cell[:-1]))
 
 
 class MetricsRegistry:

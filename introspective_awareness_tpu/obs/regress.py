@@ -76,6 +76,13 @@ def _paged_evals(doc: dict) -> Optional[float]:
     return paged.get("evals_per_sec_paged")
 
 
+def _serving_goodput(doc: dict) -> Optional[float]:
+    srv = doc.get("serving") or {}
+    if srv.get("skipped"):
+        return None
+    return srv.get("serving_goodput_evals_per_s")
+
+
 HEADLINES: tuple = (
     ("evals_per_sec_chip", _value, True, 0.10, 0.0),
     ("decode_steps_per_sec", _decode_steps, True, 0.15, 0.0),
@@ -95,6 +102,12 @@ HEADLINES: tuple = (
     # the bench's "paged_kv" section. Same history-tolerance as fabric /
     # speculative: rounds predating the section skip, never fail.
     ("paged_kv_evals_per_s", _paged_evals, True, 0.20, 0.0),
+    # Serving goodput (completed requests/s across both tenants) from the
+    # bench's "serving" section — a wall-clock measure over live HTTP with
+    # open-arrival traffic, so it carries scheduling + network jitter the
+    # throughput metrics above don't: wide relative tolerance. Rounds
+    # predating the section skip, never fail.
+    ("serving_goodput_evals_per_s", _serving_goodput, True, 0.25, 0.0),
 )
 
 
@@ -235,6 +248,9 @@ def inject_regression(history: list[tuple[Optional[dict], Any]],
     if isinstance(cur.get("paged_kv"), dict) and \
             cur["paged_kv"].get("evals_per_sec_paged"):
         cur["paged_kv"]["evals_per_sec_paged"] *= factor
+    if isinstance(cur.get("serving"), dict) and \
+            cur["serving"].get("serving_goodput_evals_per_s"):
+        cur["serving"]["serving_goodput_evals_per_s"] *= factor
     return cur
 
 

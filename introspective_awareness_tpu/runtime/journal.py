@@ -144,6 +144,11 @@ class TrialJournal:
         self._decoded: dict[str, dict] = {}
         self._graded: dict[str, dict] = {}
         self._deferred: dict[str, dict] = {}
+        # Serving-plane request lifecycle: request id -> accepted spec /
+        # terminal result. A request accepted but with no terminal record is
+        # the crash-recovery set the serve engine re-enqueues on boot.
+        self._requests: dict[str, dict] = {}
+        self._request_done: dict[str, dict] = {}
         self._regraded_cells: set[tuple[float, float]] = set()
         self.was_clean_stop = False
         self.resumed = False
@@ -261,6 +266,15 @@ class TrialJournal:
             self._deferred.setdefault(rec["pass"], {})[rec["idx"]] = rec
         elif ev == "cell_regraded":
             self._regraded_cells.add(tuple(rec["cell"]))
+        elif ev == "request":
+            self._requests[str(rec["rid"])] = rec["spec"]
+        elif ev == "request_done":
+            self._request_done[str(rec["rid"])] = rec.get("result") or {}
+        elif ev == "request_preempted":
+            # Audit-only: the victim's partial progress was discarded and
+            # the request re-queued under the same stream id, so the
+            # accepted spec (above) stays the single recovery source.
+            pass
         elif ev == "clean_stop":
             pass  # positional: only meaningful as the final record (above)
         # Unknown events are skipped: a newer writer's records must not
@@ -332,6 +346,41 @@ class TrialJournal:
             self._append({"ev": "cell_regraded", "cell": list(cell)})
             self._regraded_cells.add(tuple(cell))
 
+    def record_request(self, rid: str, spec: dict) -> None:
+        """A serving request was ACCEPTED: journal its full replayable spec
+        (tenant, priority, prompt, vector ref, steer cell, budget, sampling
+        params, stream id) before any decode work is scheduled — the WAL
+        property that makes accepted-but-unfinished requests recoverable."""
+        with self._lock:
+            self._append({"ev": "request", "rid": str(rid), "spec": spec})
+            self._requests[str(rid)] = spec
+
+    def record_request_done(self, rid: str, result: dict) -> None:
+        """Terminal record for a request: completed (token count, preemption
+        count) or failed (error string). Requests with a terminal record are
+        never re-enqueued on recovery."""
+        with self._lock:
+            self._append({"ev": "request_done", "rid": str(rid),
+                          "result": result})
+            self._request_done[str(rid)] = result
+
+    def record_request_preempted(self, rid: str, n_streamed: int) -> None:
+        """A running request was preempted: its ``n_streamed`` already-
+        emitted tokens were discarded and it re-queued on the same PRNG
+        stream (it will re-decode bit-identically). Audit trail only."""
+        with self._lock:
+            self._append({"ev": "request_preempted", "rid": str(rid),
+                          "n_streamed": int(n_streamed)})
+
+    def pending_requests(self) -> dict[str, dict]:
+        """Accepted requests with no terminal record, in acceptance order —
+        the serve engine's crash-recovery work list."""
+        with self._lock:
+            return {
+                rid: spec for rid, spec in self._requests.items()
+                if rid not in self._request_done
+            }
+
     def record_clean_stop(self) -> None:
         """Graceful-shutdown marker: in-flight chunks drained, journal
         flushed — resume can trust there was no torn write."""
@@ -384,7 +433,8 @@ class TrialJournal:
         return cells - self._regraded_cells
 
     def has_state(self) -> bool:
-        return bool(self._decoded or self._graded or self._deferred)
+        return bool(self._decoded or self._graded or self._deferred
+                    or self._requests)
 
     # -- rotation ------------------------------------------------------------
 
@@ -420,6 +470,13 @@ class TrialJournal:
                         if cell and tuple(cell) in self._regraded_cells:
                             continue
                         f.write(_frame(rec))
+                # Open (accepted, not terminal) serving requests survive
+                # rotation; terminal pairs have nothing left to recover.
+                for rid in sorted(self._requests):
+                    if rid in self._request_done:
+                        continue
+                    f.write(_frame({"ev": "request", "rid": rid,
+                                    "spec": self._requests[rid]}))
                 f.flush()
                 os.fsync(f.fileno())
             self._f.close()

@@ -219,6 +219,70 @@ class ModelRunner:
             return 0
         return L0
 
+    def _paged_route(
+        self,
+        rows: list,
+        strength_arr: np.ndarray,
+        starts: Optional[Sequence[Optional[int]]],
+        L0: int,
+    ) -> tuple[bool, dict]:
+        """Cost-model routing for ``kv_paged="auto"``: estimate the prefill
+        token mass each path would stage and take the cheaper one.
+
+        Classic two-tier prefills the queue-wide prefix once plus every
+        row's suffix: ``L0 + sum(len - L0)``. Paged prefills only what the
+        radix tree can't share; the estimate walks the queue's own prompts
+        through a host-side page-granular trie under the same caps the
+        scheduler's tree enforces (steered rows share strictly before their
+        steer start; lookup leaves >= 1 suffix token), counting full pages
+        matched against EARLIER rows — the within-queue dedup the real tree
+        realizes, ignoring only cross-call cache persistence, so it
+        underestimates paged's advantage. Queues with a short queue-wide
+        prefix but long prefixes shared among SUBSETS (per-family
+        preambles, divergent middles) now route paged instead of falling
+        back to the classic path's pessimistic broadcast test.
+        """
+        pg = int(self.kv_page_size)
+        s = np.asarray(strength_arr, np.float32)
+        total = sum(len(r) for r in rows)
+        trie: dict = {}
+        shared_tokens = 0
+        for i, r in enumerate(rows):
+            plen = len(r)
+            row_strength = float(s) if s.ndim == 0 else float(s[i])
+            if row_strength == 0.0:
+                cap = plen
+            else:
+                start = None if starts is None else starts[i]
+                cap = 0 if start is None else min(plen, max(0, int(start)))
+            lookup_pages = min(cap, plen - 1) // pg
+            insert_pages = cap // pg
+            node, matched = trie, 0
+            for p in range(insert_pages):
+                key = tuple(r[p * pg:(p + 1) * pg])
+                nxt = node.get(key)
+                if nxt is None:
+                    nxt = node[key] = {}
+                elif p < lookup_pages and matched == p:
+                    matched += 1
+                node = nxt
+            shared_tokens += matched * pg
+        classic_cost = L0 + (total - L0 * len(rows))
+        paged_cost = total - shared_tokens
+        use_paged = (
+            self.kv_paged == "on" or L0 == 0 or paged_cost < classic_cost
+        )
+        info = {
+            "decision": "paged" if use_paged else "classic",
+            "classic_prefill_tokens": int(classic_cost),
+            "paged_prefill_tokens_est": int(paged_cost),
+            "shared_tokens_est": int(shared_tokens),
+            "queue_prefix_tokens": int(L0),
+            "page_size": pg,
+            "forced": self.kv_paged == "on",
+        }
+        return use_paged, info
+
     def _stop_token_seqs(self, stop_strings: Sequence[str]):
         """Stop strings → [n_stop, Ls] int32 (-1 left-pad = wildcard).
 
@@ -919,15 +983,28 @@ class ModelRunner:
             L0 = self._prefix_split(
                 rows, strength_arr, steering_start_positions
             )
-        # Paged KV routing: queues with no broadcastable shared prefix
-        # (L0 == 0) no longer fall off the scheduled path — the page pool
-        # needs no queue-wide prefix, and the radix tree still dedups
-        # whatever prefixes subsets of the queue DO share. kv_paged="on"
-        # additionally routes shareable queues paged (A/B and forcing);
-        # "off" restores the classic two-tier + fixed-batch behavior.
-        if eligible and self.kv_paged != "off" and (
-            self.kv_paged == "on" or L0 == 0
-        ):
+        # Paged KV routing through a cost model (`_paged_route`): the paged
+        # path wins whenever its estimated prefill mass (radix dedup within
+        # the queue) beats the classic broadcast-prefix + suffix mass — so
+        # L0 == 0 queues route paged as before, and shared-prefix queues
+        # with divergent middles now do too. kv_paged="on" forces paged
+        # (A/B and forcing); "off" restores the classic two-tier +
+        # fixed-batch behavior. The decision + estimates land in
+        # last_autotune["kv_route"] and a kv_route_decision ledger event.
+        if eligible and self.kv_paged != "off":
+            use_paged, route = self._paged_route(
+                rows, strength_arr, steering_start_positions, L0
+            )
+            self.last_autotune = {
+                **(self.last_autotune or {}), "kv_route": route,
+            }
+            self.ledger.event(
+                "kv_route_decision", model=self.model_name, trials=N,
+                **route,
+            )
+        else:
+            use_paged = False
+        if use_paged:
             return self._generate_scheduled_paged(
                 rows, layer_arr, steering_vectors, strength_arr,
                 steering_start_positions, budget_list,

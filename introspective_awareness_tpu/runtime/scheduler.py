@@ -109,6 +109,56 @@ import jax.numpy as jnp
 STAGED_AUTO_SLOTS = 64
 
 
+class SchedulerFeed:
+    """Dynamic trial source for ``run_scheduled_paged(feed=...)``.
+
+    The serving front-end subclasses this to admit requests from concurrent
+    tenants into the live slot pool while the loop runs. Every method is
+    called on the SCHEDULER thread — implementations synchronize their own
+    queues and must never block.
+
+    - ``pull(k)`` returns up to ``k`` new ``(stream_id, PagedTrial)`` pairs,
+      highest priority first. ``stream_id`` is the caller-owned PRNG /
+      resume identity (``fold_in(base_key, stream_id)``): re-submitting a
+      trial under the same id — after preemption or a crash — re-decodes it
+      bit-identically.
+    - ``open()`` is False once no trial will ever arrive again; the loop
+      then exits as soon as the resident slots drain (a graceful drain —
+      running trials FINISH, unlike ``stop_event`` which aborts them).
+    - ``urgent()`` True bypasses the refill hysteresis so a waiting
+      latency-sensitive trial is admitted at the first free slot.
+    - ``take_preemptions()`` returns stream ids to evict NOW (each id is
+      returned once); the loop drains in-flight work, frees the victims'
+      slots and pages, marks their device lanes done, and confirms each
+      actually-evicted victim through ``on_preempted(stream_id,
+      n_streamed)`` (victims that finished while the eviction was in
+      flight are NOT confirmed — they completed normally).
+    """
+
+    def pull(self, k: int) -> list:
+        return []
+
+    def open(self) -> bool:
+        return False
+
+    def urgent(self) -> bool:
+        return False
+
+    def take_preemptions(self) -> list:
+        return []
+
+    def on_preempted(self, stream_id, n_streamed: int) -> None:
+        pass
+
+
+@jax.jit
+def _mask_done(state, mask):
+    """Force ``done`` on the masked slots: a preempted lane stops decoding
+    (attention 0, emits pad, state frozen) until the next admission
+    overwrites it — the same dead-lane mechanics chunk-granular EOS uses."""
+    return state._replace(done=jnp.logical_or(state.done, mask))
+
+
 @dataclass(frozen=True)
 class TrialRequest:
     """One queued generation: a per-trial suffix plus its steering cell.
@@ -805,15 +855,22 @@ class PagedTrial:
 def paged_pool_sizes(
     trials: Sequence["PagedTrial"], slots: int, page_size: int,
     max_new_tokens: int, speculate_k: int = 0,
+    max_prompt_len: Optional[int] = None,
 ) -> dict:
     """Static pool geometry for a queue: prompt-page width per slot
     (``np_max``), the minimum safe prompt pool (every slot resident with a
     full-width prompt, plus one admission in flight), and the decode pool
     (fixed per-slot pages — decode KV is never shared). Shared by
     ``run_scheduled_paged``, the runner's HBM autotune candidates, and
-    bench's memory model."""
+    bench's memory model. ``max_prompt_len`` sizes the geometry for a
+    DYNAMIC queue (``feed=``) whose trials aren't known yet; with it set,
+    ``trials`` may be empty."""
     pg = int(page_size)
-    np_max = max(1, -(-max(int(t.prompt_ids.shape[0]) for t in trials) // pg))
+    longest = max(
+        [int(t.prompt_ids.shape[0]) for t in trials]
+        + ([int(max_prompt_len)] if max_prompt_len else [])
+    )
+    np_max = max(1, -(-longest // pg))
     if speculate_k:
         n_chunks, rounds = _spec_chunk_plan(max_new_tokens, speculate_k)
         ring_w = rounds * (speculate_k + 1)
@@ -857,6 +914,9 @@ def run_scheduled_paged(
     replica: str = "0",
     speculate_k: int = 0,
     draft_layers: int = 0,
+    feed: Optional[SchedulerFeed] = None,
+    token_cb: Optional[Callable[[int, np.ndarray], None]] = None,
+    max_prompt_len: Optional[int] = None,
 ) -> tuple[list[np.ndarray], dict]:
     """``run_scheduled`` over the PAGED KV cache (``runtime.paged``).
 
@@ -884,14 +944,38 @@ def run_scheduled_paged(
     ``prompt_pool_pages`` (default: the ``paged_pool_sizes`` minimum)
     bounds prompt KV HBM; extra headroom above the minimum becomes radix
     cache capacity. Stats add ``share_hits``/``share_misses``/
-    ``share_hit_rate`` and page-pool occupancy readings."""
+    ``share_hit_rate`` and page-pool occupancy readings.
+
+    Serving mode (``feed=`` a :class:`SchedulerFeed`): the queue becomes
+    DYNAMIC — the loop pulls new ``(stream_id, PagedTrial)`` pairs from
+    the feed whenever slots can take them (priority order is the feed's),
+    keeps running while ``feed.open()``, and exits once the feed closes
+    and the resident slots drain (running trials finish — the graceful
+    counterpart of ``stop_event``, which aborts them). ``max_prompt_len``
+    must be given (it sizes the page geometry before any trial exists);
+    ``trials``/``trial_ids`` may seed the queue and are admitted first.
+    Callbacks key by STREAM ID in this mode (queue position in static
+    mode). ``feed.take_preemptions()`` evicts running trials mid-decode:
+    the loop lands in-flight work, discards the victim's partial tokens,
+    releases its pages, masks its device lane done, and confirms through
+    ``feed.on_preempted`` — the victim re-decodes bit-identically when
+    re-submitted under the same stream id (queue-indexed PRNG streams).
+    Finalized trials' prompts/results are dropped as they complete, so a
+    long-lived server's memory is bounded by the live working set, and
+    the returned ``results`` list holds placeholders in this mode.
+
+    ``token_cb(key, new_tokens)`` streams each trial's newly emitted
+    tokens the moment an event's flags land (refill first-token included,
+    finalization-truncated, pad-free) — the serving plane's chunked HTTP
+    streaming and TTFT/ITL histograms hang off it. Works in static mode
+    too (keyed by queue position)."""
     ledger = ledger if ledger is not None else NullLedger()
     B = slots
     N = len(trials)
     pg = int(page_size)
     if pg <= 0:
         raise ValueError(f"page_size must be positive, got {page_size}")
-    if N == 0:
+    if N == 0 and feed is None:
         return [], {"chunks": 0, "refills": 0, "mean_slot_occupancy": 0.0,
                     "padded_row_waste_steps": 0, "pipelined": bool(pipeline),
                     "staged": True, "interrupted": False, "paged": True,
@@ -900,13 +984,19 @@ def run_scheduled_paged(
                     "share_hits": 0, "share_misses": 0,
                     "share_hit_rate": 0.0, "prompt_pool_pages": 0,
                     "pages_in_use_peak": 0, "pages_cached": 0,
-                    "radix_nodes": 0,
+                    "radix_nodes": 0, "preempted": 0,
                     **PipelineGauges().as_stats(0.0, 0),
                     **StagedGauges().as_stats(),
                     **SpecGauges().as_stats()}
     if trial_ids is not None and len(trial_ids) != N:
         raise ValueError("trial_ids must align with trials")
-    H = int(trials[0].steer_vector.shape[0])
+    if feed is not None and not max_prompt_len:
+        raise ValueError("feed mode requires max_prompt_len (sizes the "
+                         "page geometry before any trial exists)")
+    H = (
+        int(trials[0].steer_vector.shape[0]) if N
+        else int(cfg.hidden_size)
+    )
     for t in trials:
         if int(t.prompt_ids.shape[0]) < 1:
             raise ValueError("paged trials need a non-empty prompt")
@@ -922,7 +1012,8 @@ def run_scheduled_paged(
             f"< n_layers={cfg.n_layers}, got {draft_layers}"
         )
     geom = paged_pool_sizes(
-        trials, B, pg, max_new_tokens, speculate_k=speculate_k
+        trials, B, pg, max_new_tokens, speculate_k=speculate_k,
+        max_prompt_len=max_prompt_len,
     )
     np_max = geom["np_max"]
     ring_w = geom["ring_width"]
@@ -972,16 +1063,30 @@ def run_scheduled_paged(
         stop_seqs=stop,
     )
     base_key = jax.random.key(seed)
-    stream_ids = (
-        jnp.arange(N) if trial_ids is None
-        else jnp.asarray(np.asarray(list(trial_ids), np.int64))
+    ids: list[int] = (
+        list(range(N)) if trial_ids is None else [int(i) for i in trial_ids]
     )
-    trial_keydata = np.asarray(
-        jax.vmap(lambda i: jax.random.key_data(jax.random.fold_in(base_key, i)))(
-            stream_ids
-        ),
-        np.uint32,
-    )
+
+    def _keydata_for(stream_id: int) -> np.ndarray:
+        return np.asarray(
+            jax.random.key_data(jax.random.fold_in(base_key, stream_id)),
+            np.uint32,
+        )
+
+    if N:
+        trial_keydata = list(np.asarray(
+            jax.vmap(
+                lambda i: jax.random.key_data(jax.random.fold_in(base_key, i))
+            )(jnp.asarray(np.asarray(ids, np.int64))),
+            np.uint32,
+        ))
+    else:
+        trial_keydata = []
+
+    def _cb_key(ti: int) -> int:
+        # Static mode keys callbacks by queue position (sweep contract);
+        # feed mode keys by the caller's stream id.
+        return ids[ti] if feed is not None else ti
 
     pool = PagePool(Pp)
     tree = RadixTree(pg, pool)
@@ -994,15 +1099,19 @@ def run_scheduled_paged(
     dtab_j = jnp.asarray(dtab_h)
     slot_pages: list[Optional[list[int]]] = [None] * B
 
+    trials = list(trials)
     slot_trial = np.full(B, -1, np.int64)
     rem = np.zeros(B, np.int64)
     bufs: list[list[np.ndarray]] = [[] for _ in range(N)]
     results: list[Optional[np.ndarray]] = [None] * N
+    streamed: list[int] = [0] * N
+    _consumed = np.zeros(0, np.int32)  # feed-mode finalize/preempt sentinel
     last_done = np.ones(B, bool)
     pending: deque[_InFlight] = deque()
     depth = 1 if pipeline else 0
 
     next_trial = 0
+    preempted = 0
     g = 0
     refills = 0
     chunks_done = 0
@@ -1072,6 +1181,10 @@ def run_scheduled_paged(
         "iat_paged_share_hit_rate",
         "radix share-hit fraction over admissions so far",
         labelnames=("replica",))
+    m_preempt = _reg.counter(
+        "iat_scheduler_preemptions_total",
+        "running trials preempted and returned to the feed",
+        labelnames=("replica",))
 
     def _share_caps(t: PagedTrial) -> tuple[int, int]:
         """(lookup_cap, insert_cap) in tokens. Steered trials only share /
@@ -1108,7 +1221,7 @@ def run_scheduled_paged(
             faults.tick("admission")
         free = np.flatnonzero(slot_trial < 0)
         adm: list[tuple[int, list[int], list[int], int, int]] = []
-        for _ in range(min(len(free), N - next_trial)):
+        for _ in range(min(len(free), len(trials) - next_trial)):
             qi = next_trial + len(adm)
             t = trials[qi]
             plen = int(t.prompt_ids.shape[0])
@@ -1140,7 +1253,7 @@ def run_scheduled_paged(
                     fresh_pages=len(fresh),
                 )
         if not adm:
-            if next_trial < N and not np.any(slot_trial >= 0):
+            if next_trial < len(trials) and not np.any(slot_trial >= 0):
                 raise RuntimeError(
                     "paged admission deadlock: prompt page pool too small "
                     f"({Pp} pages) for trial {next_trial}"
@@ -1333,6 +1446,27 @@ def run_scheduled_paged(
                 ti = int(ev.owners[s])
                 if ti >= 0 and results[ti] is None and not bufs[ti]:
                     bufs[ti].append(toks[s : s + 1])
+        if token_cb is not None:
+            # n_em is CUMULATIVE per slot at every event, so the valid new
+            # tokens are exactly the first (n_em - streamed) entries of this
+            # event's slab row — trailing pad from a mid-chunk finish never
+            # leaks to the client.
+            for s in range(B):
+                ti = int(ev.owners[s])
+                if ti < 0 or results[ti] is not None:
+                    continue
+                delta = int(n_em[s]) - streamed[ti]
+                if delta <= 0:
+                    continue
+                if ev.kind == "chunk":
+                    row = (
+                        toks[s, : int(cnt[s])] if cnt is not None
+                        else toks[s]
+                    )
+                else:
+                    row = toks[s : s + 1]
+                token_cb(_cb_key(ti), np.asarray(row[:delta], np.int32))
+                streamed[ti] += delta
         for s in range(B):
             ti = int(ev.owners[s])
             if ti >= 0 and results[ti] is None and done[s]:
@@ -1354,7 +1488,13 @@ def run_scheduled_paged(
                         _pool_gauges()
                 m_final.inc(**_rl)
                 if result_cb is not None:
-                    result_cb(ti, results[ti])
+                    result_cb(_cb_key(ti), results[ti])
+                if feed is not None:
+                    # Feed mode is long-lived: drop the trial and its tokens
+                    # once delivered so memory stays bounded by the backlog,
+                    # not the request history.
+                    trials[ti] = None
+                    results[ti] = _consumed
         last_done = done
         m_depth.set(len(pending), **_rl)
         if trace is not None:
@@ -1363,6 +1503,52 @@ def run_scheduled_paged(
             gauges.idle_start()
         if faults is not None and ev.kind == "chunk":
             faults.tick("chunk")
+
+    def _preempt(victims: list) -> None:
+        """Evict running trials mid-decode. All in-flight work is landed
+        first (its events reference the old tenancy), then the victims'
+        partial tokens are discarded, their pages released, and their device
+        lanes masked done so the zombie rows stop decoding. ``paged_admit``
+        clears an admitted slot's decode-tier mvalid rows, so a reused slot
+        never reads the victim's KV. The victim re-decodes from scratch
+        under its original stream id, which is the bit-identity guarantee."""
+        nonlocal state, last_done, preempted
+        vset = {int(v) for v in victims}
+        hit = [
+            (s, int(slot_trial[s])) for s in range(B)
+            if int(slot_trial[s]) >= 0
+            and results[int(slot_trial[s])] is None
+            and ids[int(slot_trial[s])] in vset
+        ]
+        if not hit:
+            return
+        while pending:
+            _process_one()
+        mask = np.zeros(B, bool)
+        for s, ti in hit:
+            if int(slot_trial[s]) != ti or results[ti] is not None:
+                continue  # finished while the in-flight work landed
+            mask[s] = True
+            slot_trial[s] = -1
+            rem[s] = 0
+            if slot_pages[s] is not None:
+                pool.release(slot_pages[s])
+                slot_pages[s] = None
+            n_str = int(streamed[ti])
+            ledger.event(
+                "slot_preempted", slot=int(s), stream_id=int(ids[ti]),
+                tokens_discarded=n_str,
+            )
+            feed.on_preempted(ids[ti], n_str)
+            bufs[ti] = []
+            results[ti] = _consumed
+            trials[ti] = None
+            preempted += 1
+            m_preempt.inc(**_rl)
+        if mask.any():
+            state = _mask_done(state, jnp.asarray(mask))
+            last_done = np.asarray(last_done) | mask
+            _pool_gauges()
 
     interrupted = False
     while True:
@@ -1373,9 +1559,38 @@ def run_scheduled_paged(
             break
         while len(pending) > depth:
             _process_one()
+        if feed is not None:
+            victims = feed.take_preemptions()
+            if victims:
+                _preempt(victims)
+            backlog = len(trials) - next_trial
+            want = int((slot_trial < 0).sum()) - backlog
+            if want > 0:
+                for tid, t in feed.pull(want):
+                    plen = int(t.prompt_ids.shape[0])
+                    if not (1 <= plen <= np_max * pg):
+                        raise ValueError(
+                            f"feed trial prompt length {plen} outside "
+                            f"[1, {np_max * pg}]"
+                        )
+                    if not (1 <= t.budget <= max_new_tokens):
+                        raise ValueError(
+                            f"feed trial budget {t.budget} outside "
+                            f"[1, {max_new_tokens}]"
+                        )
+                    trials.append(t)
+                    ids.append(int(tid))
+                    trial_keydata.append(_keydata_for(int(tid)))
+                    bufs.append([])
+                    results.append(None)
+                    streamed.append(0)
         free_cnt = int((slot_trial < 0).sum())
         n_live_known = B - free_cnt
-        if next_trial < N and (free_cnt >= refill_min or n_live_known == 0):
+        if next_trial < len(trials) and (
+            free_cnt >= refill_min
+            or n_live_known == 0
+            or (feed is not None and feed.urgent())
+        ):
             if _dispatch_admission():
                 # Same reason as the classic refill's `continue`: surface
                 # first-token finishes before burning a chunk.
@@ -1383,8 +1598,11 @@ def run_scheduled_paged(
         if n_live_known == 0:
             while pending:
                 _process_one()
-            if int((slot_trial < 0).sum()) == B and next_trial >= N:
-                break
+            if (int((slot_trial < 0).sum()) == B
+                    and next_trial >= len(trials)):
+                if feed is None or not feed.open():
+                    break
+                time.sleep(0.001)  # idle server: wait for requests
             continue
         if pending and not np.any((slot_trial >= 0) & (rem > 0)):
             _process_one()
@@ -1416,6 +1634,7 @@ def run_scheduled_paged(
         "pages_in_use_peak": int(pages_peak),
         "pages_cached": int(pool.cached_count),
         "radix_nodes": int(tree.n_nodes),
+        "preempted": int(preempted),
         **gauges.as_stats(wall_s, chunks_done),
         **sgauges.as_stats(),
         **pgauges.as_stats(),

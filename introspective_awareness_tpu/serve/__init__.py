@@ -1,0 +1,40 @@
+"""Steering-as-a-service: a persistent front-end over the paged scheduler.
+
+The paper's workload — inject a concept vector at a layer/strength and
+generate under a fixed protocol — is operationally an inference request.
+This package wraps the engine the sweeps already use (continuous paged
+scheduler, radix prefix sharing, durability journal, metrics plane) as a
+long-lived multi-tenant service; sweeps remain available as the bulk
+tenant path.
+
+- :mod:`.request` — the wire request, validation, and the named concept
+  vector store
+- :mod:`.tenants` — per-tenant admission quotas with 429 backpressure
+- :mod:`.engine` — the :class:`~.engine.ServeEngine`: a
+  ``SchedulerFeed`` that admits requests into the live slot pool, with
+  priority preemption, token streaming, and journal-backed recovery
+- :mod:`.server` — the stdlib HTTP front door (``POST /v1/steer`` +
+  the shared observability routes)
+- :mod:`.loadgen` — closed-loop + open-arrival load generator used by
+  bench's ``serving`` section and the CI smoke lane
+"""
+
+from introspective_awareness_tpu.serve.engine import ServeEngine
+from introspective_awareness_tpu.serve.request import (
+    QuotaError,
+    RequestError,
+    SteerRequest,
+    VectorStore,
+)
+from introspective_awareness_tpu.serve.server import ServeServer
+from introspective_awareness_tpu.serve.tenants import TenantTable
+
+__all__ = [
+    "QuotaError",
+    "RequestError",
+    "ServeEngine",
+    "ServeServer",
+    "SteerRequest",
+    "TenantTable",
+    "VectorStore",
+]

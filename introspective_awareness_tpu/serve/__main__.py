@@ -1,0 +1,6 @@
+import sys
+
+from introspective_awareness_tpu.cli.serve import main
+
+if __name__ == "__main__":
+    sys.exit(main())

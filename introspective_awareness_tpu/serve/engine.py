@@ -1,0 +1,397 @@
+"""The serving engine: a ``SchedulerFeed`` over one live paged scheduler.
+
+One :class:`ServeEngine` owns one ``ModelRunner`` and one scheduler
+thread running ``run_scheduled_paged(feed=engine, ...)`` for the life of
+the process. Requests from concurrent tenants are tokenized and quota-
+checked on their HTTP threads, journaled at acceptance, and queued into
+two priority classes; the scheduler thread pulls them into free slots,
+interactive first.
+
+SLO-aware preemption: when the oldest queued interactive request has
+waited past ``preempt_after_s`` and bulk trials hold slots, the engine
+names the most-recently-admitted bulk victims (least decoded work lost).
+The scheduler evicts them, the engine journals the preemption and
+requeues each victim at the FRONT of the bulk queue under its original
+stream id — the scheduler's PRNG folds only that id, so the re-decoded
+trial is bit-identical to its un-preempted reference.
+
+Token streaming: the scheduler's ``token_cb`` delivers each slot's newly
+emitted tokens per decode chunk. Interactive requests forward them as
+incremental text; bulk requests buffer to completion (a preemptable
+trial must not stream partials that a later eviction would retract).
+TTFT/ITL land in registry histograms, labeled by priority class (bounded
+cardinality; per-tenant visibility lives in the tenant gauges).
+
+Crash recovery: requests journaled as accepted but not done are
+re-enqueued on boot under their journaled stream ids, so a crashed
+server's backlog completes with the same outputs it would have produced.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+import numpy as np
+
+from introspective_awareness_tpu.obs.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+from introspective_awareness_tpu.runtime.scheduler import (
+    PagedTrial,
+    SchedulerFeed,
+    run_scheduled_paged,
+)
+from introspective_awareness_tpu.serve.request import (
+    QuotaError,
+    RequestError,
+    SteerRequest,
+    VectorStore,
+)
+from introspective_awareness_tpu.serve.tenants import TenantTable
+
+# TTFT/ITL bucket ladders sized for CPU-smoke through accelerator serving.
+TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                30.0, 60.0)
+ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+               1.0, 2.5)
+
+
+class ResponseStream:
+    """Per-request hand-off between the scheduler thread and the HTTP
+    handler: a queue of ``{"text": ...}`` deltas ending in one terminal
+    ``{"done": ...}`` / ``{"error": ...}`` document."""
+
+    def __init__(self, req: SteerRequest, trial: PagedTrial,
+                 stream_id: int) -> None:
+        self.req = req
+        self.trial = trial
+        self.stream_id = int(stream_id)
+        self.q: "queue.Queue[dict]" = queue.Queue()
+        self.t_enqueue = time.monotonic()
+        self.t_first: Optional[float] = None
+        self.t_last: Optional[float] = None
+        self.n_tokens = 0
+        self.preemptions = 0
+
+
+class ServeEngine(SchedulerFeed):
+    def __init__(
+        self,
+        runner: Any,
+        *,
+        slots: int = 4,
+        max_new_tokens: int = 64,
+        max_prompt_len: int = 512,
+        temperature: float = 0.0,
+        seed: int = 0,
+        preempt_after_s: float = 0.25,
+        tenants: Optional[TenantTable] = None,
+        vectors: Optional[VectorStore] = None,
+        journal=None,
+        registry: Optional[MetricsRegistry] = None,
+        replica: str = "serve",
+    ) -> None:
+        self.runner = runner
+        self.slots = int(slots)
+        self.max_new_tokens = int(max_new_tokens)
+        self.max_prompt_len = int(max_prompt_len)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.preempt_after_s = float(preempt_after_s)
+        self.journal = journal
+        self.replica = str(replica)
+        self.tenants = tenants if tenants is not None else TenantTable(
+            registry=registry)
+        self.vectors = vectors if vectors is not None else VectorStore(
+            int(runner.cfg.hidden_size))
+
+        self._lock = threading.Lock()
+        self._streams: dict[int, ResponseStream] = {}
+        self._q_inter: deque[int] = deque()
+        self._q_bulk: deque[int] = deque()
+        self._running: set[int] = set()
+        self._run_order: list[int] = []  # admission order, oldest first
+        self._preempt_issued: set[int] = set()
+        self._next_stream = 0
+        self._accepting = True
+        self._thread: Optional[threading.Thread] = None
+        self._loop_error: Optional[BaseException] = None
+        self.stats: dict = {}
+
+        reg = registry if registry is not None else default_registry()
+        self._h_ttft = reg.histogram(
+            "iat_serve_ttft_seconds",
+            "accept-to-first-token latency, by priority class",
+            labelnames=("priority",), buckets=TTFT_BUCKETS)
+        self._h_itl = reg.histogram(
+            "iat_serve_itl_seconds",
+            "mean inter-token latency per decode chunk, by priority class",
+            labelnames=("priority",), buckets=ITL_BUCKETS)
+        self._c_accepted = reg.counter(
+            "iat_serve_requests_accepted_total",
+            "requests past quota + validation", labelnames=("priority",))
+        self._c_completed = reg.counter(
+            "iat_serve_requests_completed_total",
+            "requests finalized with a result", labelnames=("priority",))
+        self._c_preempted = reg.counter(
+            "iat_serve_requests_preempted_total",
+            "bulk requests evicted for an interactive SLO")
+        self._special = set(int(e) for e in runner.tokenizer.eos_ids)
+        self._special.add(int(runner.tokenizer.pad_id))
+
+    # -- request plane (HTTP threads) ---------------------------------------
+
+    def submit(self, req: SteerRequest, *,
+               recovered: bool = False) -> ResponseStream:
+        """Validate, quota-check, journal, and enqueue one request.
+        Returns its :class:`ResponseStream`; raises :class:`RequestError`
+        (400) or :class:`QuotaError` (429)."""
+        if req.temperature != self.temperature:
+            raise RequestError(
+                f"temperature is engine-global ({self.temperature}); "
+                f"per-request temperature is not supported"
+            )
+        vec = self.vectors.get(req.vector)
+        strength = 0.0 if req.vector == "null" else float(req.strength)
+        prompt_ids = np.asarray(
+            self.runner.tokenizer.encode(req.prompt), np.int32
+        )
+        plen = int(prompt_ids.shape[0])
+        if not (1 <= plen <= self.max_prompt_len):
+            raise RequestError(
+                f"prompt is {plen} tokens; server accepts 1..."
+                f"{self.max_prompt_len}"
+            )
+        trial = PagedTrial(
+            prompt_ids=prompt_ids,
+            steer_layer=int(req.layer),
+            steer_strength=strength,
+            steer_vector=vec,
+            steer_start=min(max(0, int(req.steer_start)), plen - 1),
+            budget=min(int(req.max_new_tokens), self.max_new_tokens),
+        )
+        if not recovered:
+            retry = self.tenants.try_admit(req.tenant)
+            if retry is not None:
+                raise QuotaError(req.tenant, retry)
+        else:
+            self.tenants.force_admit(req.tenant)
+        with self._lock:
+            if not self._accepting:
+                self.tenants.on_finish(req.tenant, was_running=False)
+                raise RequestError("server is draining; resubmit elsewhere")
+            if req.stream is not None:
+                sid = int(req.stream)
+                if sid in self._streams:
+                    self.tenants.on_finish(req.tenant, was_running=False)
+                    raise RequestError(f"stream id {sid} is already live")
+            else:
+                sid = self._next_stream
+            self._next_stream = max(self._next_stream, sid + 1)
+            st = ResponseStream(req, trial, sid)
+            self._streams[sid] = st
+            if self.journal is not None and not recovered:
+                self.journal.record_request(
+                    req.rid, {**req.spec(), "stream": sid}
+                )
+            (self._q_inter if req.priority == "interactive"
+             else self._q_bulk).append(sid)
+        self._c_accepted.inc(priority=req.priority)
+        return st
+
+    def recover(self) -> int:
+        """Re-enqueue accepted-but-unfinished requests from the journal
+        (their clients are gone; results land in the journal). Returns
+        the number recovered."""
+        if self.journal is None:
+            return 0
+        n = 0
+        for rid, spec in sorted(self.journal.pending_requests().items()):
+            try:
+                req = SteerRequest.from_spec(rid, spec)
+                self.submit(req, recovered=True)
+                n += 1
+            except (RequestError, TypeError) as e:
+                # A spec this build can't satisfy must not wedge boot.
+                self.runner.ledger.event(
+                    "serve_recover_skipped", rid=str(rid), error=str(e)
+                )
+        return n
+
+    # -- SchedulerFeed (scheduler thread) -----------------------------------
+
+    def pull(self, k: int) -> list:
+        out: list = []
+        with self._lock:
+            if not self._accepting:
+                return out
+            while len(out) < k and (self._q_inter or self._q_bulk):
+                sid = (self._q_inter.popleft() if self._q_inter
+                       else self._q_bulk.popleft())
+                st = self._streams[sid]
+                self._running.add(sid)
+                self._run_order.append(sid)
+                out.append((sid, st.trial))
+                self.tenants.on_start(st.req.tenant)
+        return out
+
+    def open(self) -> bool:
+        return self._accepting
+
+    def urgent(self) -> bool:
+        with self._lock:
+            return bool(self._q_inter) and self._accepting
+
+    def take_preemptions(self) -> list:
+        now = time.monotonic()
+        with self._lock:
+            if not self._q_inter:
+                return []
+            oldest = self._streams[self._q_inter[0]].t_enqueue
+            if now - oldest < self.preempt_after_s:
+                return []
+            victims = [
+                sid for sid in reversed(self._run_order)
+                if sid in self._running
+                and sid not in self._preempt_issued
+                and self._streams[sid].req.priority == "bulk"
+            ][: len(self._q_inter)]
+            self._preempt_issued.update(victims)
+            return victims
+
+    def on_preempted(self, stream_id, n_streamed: int) -> None:
+        sid = int(stream_id)
+        with self._lock:
+            st = self._streams.get(sid)
+            self._preempt_issued.discard(sid)
+            if st is None:
+                return
+            self._running.discard(sid)
+            if sid in self._run_order:
+                self._run_order.remove(sid)
+            # The victim restarts from scratch under the same stream id:
+            # drop its partial progress so the resumed decode re-reports.
+            st.n_tokens = 0
+            st.t_first = None
+            st.t_last = None
+            st.preemptions += 1
+            self._q_bulk.appendleft(sid)
+            self.tenants.on_requeue(st.req.tenant)
+        self._c_preempted.inc()
+        if self.journal is not None:
+            self.journal.record_request_preempted(st.req.rid, int(n_streamed))
+
+    # -- scheduler callbacks (scheduler thread) -----------------------------
+
+    def _delta_text(self, toks: np.ndarray) -> str:
+        ids = [int(t) for t in toks if int(t) not in self._special]
+        if not ids:
+            return ""
+        return self.runner.tokenizer.decode(ids, skip_special_tokens=True)
+
+    def _on_tokens(self, sid: int, toks: np.ndarray) -> None:
+        st = self._streams.get(int(sid))
+        if st is None:
+            return
+        now = time.monotonic()
+        n = int(toks.shape[0])
+        pr = st.req.priority
+        if st.t_first is None:
+            st.t_first = now
+            self._h_ttft.observe(now - st.t_enqueue, priority=pr)
+        elif st.t_last is not None and n:
+            self._h_itl.observe((now - st.t_last) / n, priority=pr)
+        st.t_last = now
+        st.n_tokens += n
+        if pr == "interactive":
+            text = self._delta_text(toks)
+            if text:
+                st.q.put({"text": text})
+
+    def _on_result(self, sid: int, toks: np.ndarray) -> None:
+        with self._lock:
+            st = self._streams.pop(int(sid), None)
+            self._running.discard(int(sid))
+            self._preempt_issued.discard(int(sid))
+            if int(sid) in self._run_order:
+                self._run_order.remove(int(sid))
+        if st is None:
+            return
+        text = self.runner._decode_row(np.asarray(toks))
+        self.tenants.on_finish(st.req.tenant)
+        self._c_completed.inc(priority=st.req.priority)
+        if self.journal is not None:
+            self.journal.record_request_done(st.req.rid, {
+                "n_tokens": int(np.asarray(toks).shape[0]),
+                "preemptions": int(st.preemptions),
+            })
+        st.q.put({
+            "done": True, "rid": st.req.rid, "text": text,
+            "n_tokens": int(np.asarray(toks).shape[0]),
+            "preemptions": int(st.preemptions),
+            "stream": st.stream_id,
+        })
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ServeEngine":
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        r = self.runner
+
+        def _loop() -> None:
+            try:
+                _, self.stats = run_scheduled_paged(
+                    r.params, r.cfg, [],
+                    slots=self.slots,
+                    max_new_tokens=self.max_new_tokens,
+                    page_size=r.kv_page_size,
+                    temperature=self.temperature,
+                    eos_ids=list(r.tokenizer.eos_ids),
+                    pad_id=int(r.tokenizer.pad_id),
+                    seed=self.seed,
+                    ledger=r.ledger,
+                    pipeline=True,
+                    result_cb=self._on_result,
+                    feed=self,
+                    token_cb=self._on_tokens,
+                    max_prompt_len=self.max_prompt_len,
+                    replica=self.replica,
+                )
+            except BaseException as e:  # noqa: BLE001 — surfaced at close()
+                self._loop_error = e
+                r.ledger.event("serve_loop_crashed", error=repr(e))
+
+        self._thread = threading.Thread(
+            target=_loop, name="serve-scheduler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 120.0) -> dict:
+        """Graceful drain: stop accepting, let RUNNING trials finish,
+        leave queued-but-unstarted requests journaled for the next boot,
+        then join the scheduler thread. Returns the loop stats."""
+        with self._lock:
+            self._accepting = False
+            orphans = list(self._q_inter) + list(self._q_bulk)
+            self._q_inter.clear()
+            self._q_bulk.clear()
+        for sid in orphans:
+            st = self._streams.pop(sid, None)
+            if st is not None:
+                st.q.put({"error": "server draining; request journaled "
+                                   "for recovery", "rid": st.req.rid})
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        if self._loop_error is not None:
+            raise RuntimeError("serve scheduler crashed") from self._loop_error
+        return dict(self.stats)
+
+
+__all__ = ["ResponseStream", "ServeEngine", "ITL_BUCKETS", "TTFT_BUCKETS"]

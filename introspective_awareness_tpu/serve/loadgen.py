@@ -1,0 +1,208 @@
+"""Load generator for the steering service (bench + CI smoke driver).
+
+Two tenant workloads run concurrently against a live server:
+
+- ``interactive``: N closed-loop clients — each submits, reads its
+  chunked stream to completion (recording client-side TTFT and
+  inter-chunk latencies), then immediately submits again. A 429 backs
+  off for the server's Retry-After hint.
+- ``bulk``: open arrivals — a Poisson process (exponential gaps, seeded)
+  fires submissions regardless of completions, the pattern that actually
+  builds queue depth and forces preemptions.
+
+Prompt lengths are heavy-tailed (Pareto), so slot residency varies the
+way real chat traffic does. Everything is stdlib ``http.client``; the
+returned dict is bench's ``serving`` section payload.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+_WORDS = ("the", "of", "describe", "thought", "concept", "inject",
+          "notice", "answer", "signal", "quiet", "loud", "state")
+
+
+def percentile(vals: list, q: float) -> Optional[float]:
+    """Nearest-rank percentile; None on empty input."""
+    if not vals:
+        return None
+    s = sorted(vals)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return float(s[idx])
+
+
+def heavy_tail_prompt(rng: np.random.Generator, base_tokens: int = 12,
+                      alpha: float = 1.3, cap_tokens: int = 200) -> str:
+    """~``base``-token prompts with a Pareto tail capped at ``cap``."""
+    n = int(min(cap_tokens, base_tokens * (1.0 + rng.pareto(alpha))))
+    words = [_WORDS[int(rng.integers(len(_WORDS)))] for _ in range(max(1, n // 4))]
+    return " ".join(words)
+
+
+class _Collector:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.ttft: dict[str, list[float]] = {"interactive": [], "bulk": []}
+        self.itl: list[float] = []
+        self.completed: dict[str, int] = {"interactive": 0, "bulk": 0}
+        self.rejected_429 = 0
+        self.preemptions = 0
+        self.errors = 0
+
+
+def _one_request(host: str, port: int, doc: dict, collector: _Collector,
+                 timeout_s: float = 120.0) -> Optional[float]:
+    """POST one request and drain its stream. Returns the server's
+    Retry-After hint on a 429, else None."""
+    pr = doc.get("priority", "interactive")
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        t0 = time.monotonic()
+        conn.request(
+            "POST", "/v1/steer", json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        if resp.status == 429:
+            body = json.loads(resp.read() or b"{}")
+            with collector.lock:
+                collector.rejected_429 += 1
+            return float(body.get("retry_after_s", 1.0))
+        if resp.status != 200:
+            resp.read()
+            with collector.lock:
+                collector.errors += 1
+            return None
+        t_prev: Optional[float] = None
+        ok = False
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            now = time.monotonic()
+            rec = json.loads(line)
+            if t_prev is None:
+                with collector.lock:
+                    collector.ttft[pr].append(now - t0)
+            else:
+                with collector.lock:
+                    collector.itl.append(now - t_prev)
+            t_prev = now
+            if rec.get("done"):
+                ok = True
+                with collector.lock:
+                    collector.completed[pr] += 1
+                    collector.preemptions += int(rec.get("preemptions", 0))
+                break
+            if "error" in rec:
+                break
+        if not ok:
+            with collector.lock:
+                collector.errors += 1
+        return None
+    except (OSError, http.client.HTTPException, ValueError):
+        with collector.lock:
+            collector.errors += 1
+        return None
+    finally:
+        conn.close()
+
+
+def run_loadgen(
+    host: str,
+    port: int,
+    *,
+    duration_s: float = 10.0,
+    interactive_clients: int = 2,
+    bulk_rate_hz: float = 2.0,
+    seed: int = 0,
+    vector: str = "demo",
+    layer: int = 1,
+    strength: float = 2.0,
+    interactive_max_new: int = 8,
+    bulk_max_new: int = 32,
+    prompt_base_tokens: int = 12,
+    prompt_cap_tokens: int = 200,
+) -> dict[str, Any]:
+    """Drive the two-tenant workload for ``duration_s`` and summarize."""
+    collector = _Collector()
+    deadline = time.monotonic() + float(duration_s)
+    threads: list[threading.Thread] = []
+
+    def _interactive(i: int) -> None:
+        rng = np.random.default_rng(seed * 1000 + i)
+        while time.monotonic() < deadline:
+            retry = _one_request(host, port, {
+                "tenant": "chat", "priority": "interactive",
+                "prompt": heavy_tail_prompt(
+                    rng, prompt_base_tokens, cap_tokens=prompt_cap_tokens),
+                "vector": vector, "layer": layer, "strength": strength,
+                "max_new_tokens": interactive_max_new,
+            }, collector)
+            if retry is not None:
+                time.sleep(min(retry, 0.5))
+
+    def _bulk() -> None:
+        rng = np.random.default_rng(seed * 1000 + 999)
+        inflight: list[threading.Thread] = []
+        while time.monotonic() < deadline:
+            doc = {
+                "tenant": "sweep", "priority": "bulk",
+                "prompt": heavy_tail_prompt(
+                    rng, prompt_base_tokens, cap_tokens=prompt_cap_tokens),
+                "vector": vector, "layer": layer, "strength": strength,
+                "max_new_tokens": bulk_max_new,
+            }
+            t = threading.Thread(
+                target=_one_request, args=(host, port, doc, collector),
+                daemon=True,
+            )
+            t.start()
+            inflight.append(t)
+            time.sleep(float(rng.exponential(1.0 / max(bulk_rate_hz, 1e-6))))
+        for t in inflight:
+            t.join(timeout=max(1.0, deadline + 60.0 - time.monotonic()))
+
+    for i in range(int(interactive_clients)):
+        threads.append(threading.Thread(target=_interactive, args=(i,),
+                                        daemon=True))
+    if bulk_rate_hz > 0:
+        threads.append(threading.Thread(target=_bulk, daemon=True))
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 120.0)
+    wall = time.monotonic() - t_start
+
+    ttft_i = collector.ttft["interactive"]
+    completed = sum(collector.completed.values())
+    return {
+        "duration_s": round(wall, 3),
+        "completed_interactive": collector.completed["interactive"],
+        "completed_bulk": collector.completed["bulk"],
+        "rejected_429": collector.rejected_429,
+        "preemptions": collector.preemptions,
+        "errors": collector.errors,
+        "ttft_p50_s": percentile(ttft_i, 0.50),
+        "ttft_p99_s": percentile(ttft_i, 0.99),
+        "ttft_bulk_p50_s": percentile(collector.ttft["bulk"], 0.50),
+        "itl_p50_s": percentile(collector.itl, 0.50),
+        "itl_p99_s": percentile(collector.itl, 0.99),
+        "serving_goodput_evals_per_s": (
+            round(completed / wall, 4) if wall > 0 else 0.0
+        ),
+    }
+
+
+__all__ = ["heavy_tail_prompt", "percentile", "run_loadgen"]

@@ -1,0 +1,176 @@
+"""Wire request for the steering service + the named concept-vector store.
+
+A steering request is JSON over ``POST /v1/steer``:
+
+.. code-block:: json
+
+    {"tenant": "demo", "priority": "interactive",
+     "prompt": "<chat-formatted prompt>",
+     "vector": "all_caps", "layer": 2, "strength": 4.0,
+     "steer_start": 0, "max_new_tokens": 32, "temperature": 0.0,
+     "stream": 12345}
+
+``vector`` names an entry in the :class:`VectorStore` (vectors are
+server-side state — clients never ship raw activation tensors).
+``stream`` is OPTIONAL: the caller-pinned PRNG/resume identity. Two
+submissions with the same spec and the same stream id decode
+bit-identically — across preemption, crash recovery, and server restarts
+with the same base seed — because the scheduler folds the stream id (not
+the slot or arrival time) into the PRNG key. Omitted, the engine assigns
+the next free id.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import uuid
+import zlib
+from typing import Any, Optional
+
+import numpy as np
+
+PRIORITIES = ("interactive", "bulk")
+
+
+class RequestError(ValueError):
+    """Malformed or unsatisfiable request — maps to HTTP 400."""
+
+
+class QuotaError(Exception):
+    """Tenant over budget — maps to HTTP 429 + Retry-After."""
+
+    def __init__(self, tenant: str, retry_after_s: float) -> None:
+        super().__init__(f"tenant {tenant!r} over quota")
+        self.tenant = tenant
+        self.retry_after_s = float(retry_after_s)
+
+
+@dataclasses.dataclass
+class SteerRequest:
+    """One validated steering request (pre-tokenization)."""
+
+    rid: str
+    tenant: str
+    priority: str
+    prompt: str
+    vector: str
+    layer: int
+    strength: float
+    steer_start: int
+    max_new_tokens: int
+    temperature: float
+    stream: Optional[int] = None  # caller-pinned PRNG/resume identity
+
+    def spec(self) -> dict:
+        """JSON-normalized form journaled at acceptance; round-trips
+        through :meth:`from_spec` for crash recovery."""
+        d = dataclasses.asdict(self)
+        d.pop("rid")
+        return d
+
+    @classmethod
+    def from_spec(cls, rid: str, spec: dict) -> "SteerRequest":
+        return cls(rid=str(rid), **spec)
+
+
+def parse_request(body: bytes) -> SteerRequest:
+    """Decode + validate one wire request. Raises :class:`RequestError`
+    with a client-safe message on any problem."""
+    try:
+        doc = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise RequestError(f"invalid JSON body: {e}") from None
+    if not isinstance(doc, dict):
+        raise RequestError("request body must be a JSON object")
+
+    def _str(key: str, default: Optional[str] = None) -> str:
+        v = doc.get(key, default)
+        if not isinstance(v, str) or not v:
+            raise RequestError(f"{key!r} must be a non-empty string")
+        return v
+
+    def _num(key: str, default: Any, lo: float, hi: float) -> float:
+        v = doc.get(key, default)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            raise RequestError(f"{key!r} must be a number")
+        if not (lo <= float(v) <= hi):
+            raise RequestError(f"{key!r}={v} outside [{lo}, {hi}]")
+        return float(v)
+
+    priority = doc.get("priority", "interactive")
+    if priority not in PRIORITIES:
+        raise RequestError(f"priority must be one of {PRIORITIES}")
+    stream = doc.get("stream")
+    if stream is not None and (
+        not isinstance(stream, int) or isinstance(stream, bool) or stream < 0
+    ):
+        raise RequestError("'stream' must be a non-negative integer")
+    return SteerRequest(
+        rid=str(doc.get("rid") or uuid.uuid4().hex[:16]),
+        tenant=_str("tenant", "default"),
+        priority=priority,
+        prompt=_str("prompt"),
+        vector=_str("vector", "null"),
+        layer=int(_num("layer", 0, 0, 1_000)),
+        strength=_num("strength", 0.0, -1e4, 1e4),
+        steer_start=int(_num("steer_start", 0, 0, 1_000_000)),
+        max_new_tokens=int(_num("max_new_tokens", 32, 1, 100_000)),
+        temperature=_num("temperature", 0.0, 0.0, 10.0),
+        stream=stream,
+    )
+
+
+class VectorStore:
+    """Named concept vectors resolved server-side at admission.
+
+    Registered vectors (e.g. harvested by the extraction pipeline) are
+    returned as-is. Unknown names synthesize a deterministic unit vector
+    seeded by ``crc32(name)`` — stable across processes and restarts
+    (unlike ``hash()``), so smoke traffic and the CI bit-identity check
+    need no pre-provisioned vectors. ``"null"`` is the reserved zero
+    vector (strength is forced to 0 by the engine when selected).
+    """
+
+    def __init__(self, hidden_size: int) -> None:
+        self.hidden_size = int(hidden_size)
+        self._lock = threading.Lock()
+        self._vectors: dict[str, np.ndarray] = {}
+
+    def register(self, name: str, vec: np.ndarray) -> None:
+        v = np.asarray(vec, np.float32).reshape(-1)
+        if v.shape[0] != self.hidden_size:
+            raise ValueError(
+                f"vector {name!r} has dim {v.shape[0]}, "
+                f"model hidden is {self.hidden_size}"
+            )
+        with self._lock:
+            self._vectors[str(name)] = v
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._vectors)
+
+    def get(self, name: str) -> np.ndarray:
+        name = str(name)
+        with self._lock:
+            v = self._vectors.get(name)
+        if v is not None:
+            return v
+        if name == "null":
+            return np.zeros(self.hidden_size, np.float32)
+        seed = zlib.crc32(name.encode("utf-8"))
+        rng = np.random.default_rng(seed)
+        v = rng.standard_normal(self.hidden_size).astype(np.float32)
+        return v / max(float(np.linalg.norm(v)), 1e-8)
+
+
+__all__ = [
+    "PRIORITIES",
+    "QuotaError",
+    "RequestError",
+    "SteerRequest",
+    "VectorStore",
+    "parse_request",
+]

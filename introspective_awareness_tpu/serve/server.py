@@ -1,0 +1,173 @@
+"""The HTTP front door: ``POST /v1/steer`` + the observability plane.
+
+Stdlib-only (``ThreadingHTTPServer``, HTTP/1.1): one port serves both
+the steering endpoint and the shared ``/metrics`` / ``/progress`` /
+``/registry`` / ``/healthz`` routes (reused from ``obs.http``), so a
+serving pod needs no sidecar wiring.
+
+``POST /v1/steer`` responses are chunked ``application/x-ndjson``: zero
+or more ``{"text": ...}`` incremental lines (interactive requests
+stream; bulk requests buffer — a preemptable trial must not stream
+partials a later eviction would retract), then exactly one terminal line
+— ``{"done": true, "rid", "text", "n_tokens", "preemptions", "stream"}``
+on success or ``{"error": ...}``. Over-quota submissions get a plain 429
+with ``Retry-After``; malformed requests a 400.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from introspective_awareness_tpu.obs.http import (
+    HealthState,
+    ProgressTracker,
+    handle_observability_get,
+    send_http,
+)
+from introspective_awareness_tpu.obs.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+from introspective_awareness_tpu.serve.engine import ServeEngine
+from introspective_awareness_tpu.serve.request import (
+    QuotaError,
+    RequestError,
+    parse_request,
+)
+
+MAX_BODY_BYTES = 1 << 20  # a steering request is small; bound abuse
+STREAM_IDLE_TIMEOUT_S = 300.0
+
+
+class ServeServer:
+    """HTTP wrapper around one :class:`ServeEngine`."""
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        registry: Optional[MetricsRegistry] = None,
+        progress: Optional[ProgressTracker] = None,
+        health: Optional[HealthState] = None,
+    ) -> None:
+        self.engine = engine
+        self.registry = registry if registry is not None else default_registry()
+        self.progress = progress
+        self.health = health if health is not None else HealthState()
+        self._host = host
+        self._want_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("ServeServer not started")
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self) -> "ServeServer":
+        engine, registry = self.engine, self.registry
+        progress, health = self.progress, self.health
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"  # required for chunked responses
+
+            def log_message(self, *a: Any) -> None:
+                pass
+
+            def _chunk(self, data: bytes) -> None:
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            def do_GET(self) -> None:
+                path = self.path.split("?", 1)[0]
+                if not handle_observability_get(
+                    self, path, registry, progress, health
+                ):
+                    send_http(self, 404, "text/plain", b"not found\n")
+
+            def do_POST(self) -> None:
+                path = self.path.split("?", 1)[0]
+                if path != "/v1/steer":
+                    send_http(self, 404, "text/plain", b"not found\n")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    n = -1
+                if not (0 < n <= MAX_BODY_BYTES):
+                    send_http(self, 400, "text/plain",
+                              b"missing or oversized body\n")
+                    return
+                try:
+                    stream = engine.submit(parse_request(self.rfile.read(n)))
+                except QuotaError as e:
+                    send_http(
+                        self, 429, "application/json",
+                        json.dumps({
+                            "error": "over quota", "tenant": e.tenant,
+                            "retry_after_s": e.retry_after_s,
+                        }).encode() + b"\n",
+                        extra_headers={
+                            "Retry-After": max(1, int(e.retry_after_s))
+                        },
+                    )
+                    return
+                except RequestError as e:
+                    send_http(self, 400, "application/json",
+                              json.dumps({"error": str(e)}).encode() + b"\n")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                while True:
+                    try:
+                        doc = stream.q.get(timeout=STREAM_IDLE_TIMEOUT_S)
+                    except Exception:  # queue.Empty — decode wedged
+                        doc = {"error": "stream timed out",
+                               "rid": stream.req.rid}
+                    try:
+                        self._chunk(json.dumps(doc).encode() + b"\n")
+                    except (BrokenPipeError, ConnectionResetError):
+                        return  # client went away; decode continues
+                    if doc.get("done") or "error" in doc:
+                        break  # terminal line sent
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+
+        self._httpd = ThreadingHTTPServer((self._host, self._want_port),
+                                          _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "ServeServer":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+__all__ = ["ServeServer", "MAX_BODY_BYTES", "STREAM_IDLE_TIMEOUT_S"]

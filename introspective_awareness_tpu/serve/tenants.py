@@ -1,0 +1,114 @@
+"""Per-tenant admission quotas with backpressure.
+
+Each tenant gets an in-flight budget (requests admitted into the slot
+pool or queued for it) and a queued budget; exceeding either rejects the
+request with a Retry-After estimate (HTTP 429 at the server layer), so a
+runaway tenant backs off instead of starving the pool.
+
+Label-cardinality contract: tenant names configured at startup are
+reserved in the registry (``reserve_label_values``), so a burst of
+unknown tenants collapses into the ``other`` overflow series instead of
+evicting fabric/replica series — the serving plane can never degrade the
+sweep fleet's telemetry.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from introspective_awareness_tpu.obs.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+
+
+class TenantTable:
+    """Thread-safe quota ledger keyed by tenant name.
+
+    A request's lifecycle against the table: ``try_admit`` (queued) →
+    ``on_start`` (queued→running at scheduler pull) → ``on_finish``
+    (running drops), with ``on_requeue`` (running→queued) on preemption.
+    Unknown tenants are admitted under the default quota — quotas bound
+    damage, they are not auth.
+    """
+
+    def __init__(
+        self,
+        max_inflight: int = 4,
+        max_queued: int = 16,
+        known_tenants: Sequence[str] = (),
+        registry: Optional[MetricsRegistry] = None,
+    ) -> None:
+        self.max_inflight = int(max_inflight)
+        self.max_queued = int(max_queued)
+        self._lock = threading.Lock()
+        self._queued: dict[str, int] = {}
+        self._running: dict[str, int] = {}
+        reg = registry if registry is not None else default_registry()
+        if known_tenants:
+            reg.reserve_label_values("tenant", [str(t) for t in known_tenants])
+        self._g_queued = reg.gauge(
+            "iat_serve_tenant_queued",
+            "requests accepted but not yet running, per tenant",
+            labelnames=("tenant",))
+        self._g_running = reg.gauge(
+            "iat_serve_tenant_running",
+            "requests currently in the slot pool, per tenant",
+            labelnames=("tenant",))
+        self._c_rejected = reg.counter(
+            "iat_serve_rejected_total",
+            "requests rejected over quota (HTTP 429), per tenant",
+            labelnames=("tenant",))
+
+    def _set_gauges(self, tenant: str) -> None:
+        self._g_queued.set(float(self._queued.get(tenant, 0)), tenant=tenant)
+        self._g_running.set(float(self._running.get(tenant, 0)), tenant=tenant)
+
+    def try_admit(self, tenant: str) -> Optional[float]:
+        """None = admitted (tenant now holds one queued unit); else the
+        Retry-After estimate in seconds for a 429."""
+        tenant = str(tenant)
+        with self._lock:
+            q = self._queued.get(tenant, 0)
+            r = self._running.get(tenant, 0)
+            if q >= self.max_queued or q + r >= self.max_inflight + self.max_queued:
+                self._c_rejected.inc(tenant=tenant)
+                # Crude service-time model: each queued unit retires in
+                # ~1s; clients jitter on top of it.
+                return round(1.0 + 0.25 * q, 2)
+            self._queued[tenant] = q + 1
+            self._set_gauges(tenant)
+            return None
+
+    def force_admit(self, tenant: str) -> None:
+        """Unconditional queued unit — journal recovery re-admits the
+        crashed backlog even past quota (it was already accepted once)."""
+        tenant = str(tenant)
+        with self._lock:
+            self._queued[tenant] = self._queued.get(tenant, 0) + 1
+            self._set_gauges(tenant)
+
+    def on_start(self, tenant: str) -> None:
+        tenant = str(tenant)
+        with self._lock:
+            self._queued[tenant] = max(0, self._queued.get(tenant, 0) - 1)
+            self._running[tenant] = self._running.get(tenant, 0) + 1
+            self._set_gauges(tenant)
+
+    def on_requeue(self, tenant: str) -> None:
+        tenant = str(tenant)
+        with self._lock:
+            self._running[tenant] = max(0, self._running.get(tenant, 0) - 1)
+            self._queued[tenant] = self._queued.get(tenant, 0) + 1
+            self._set_gauges(tenant)
+
+    def on_finish(self, tenant: str, *, was_running: bool = True) -> None:
+        tenant = str(tenant)
+        with self._lock:
+            k = self._running if was_running else self._queued
+            k[tenant] = max(0, k.get(tenant, 0) - 1)
+            self._set_gauges(tenant)
+
+
+__all__ = ["TenantTable"]

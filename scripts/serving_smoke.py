@@ -1,0 +1,217 @@
+"""Serving smoke: the CI lane for the steering-service contract
+(README "Serving"), runnable anywhere the tier-1 suite runs:
+
+    JAX_PLATFORMS=cpu python scripts/serving_smoke.py
+
+Phase 1 — preemption bit-identity, over real HTTP: a one-slot server at
+temperature 0.7 decodes a bulk request with a pinned stream id while
+interactive arrivals force a mid-decode preemption (the strong, sampled
+form of the claim — greedy would be trivially identical). The victim is
+requeued under its journal/PRNG identity and must finish; the same
+request resubmitted on the quiesced server must produce byte-identical
+text. SIGTERM must then drain the server to exit 0 with a
+``clean_shutdown`` manifest recording ``preempted >= 1``.
+
+Phase 2 — two-tenant load: ``serve.loadgen`` drives closed-loop
+interactive clients against an open-arrival bulk tenant on a fresh
+greedy server with tight quotas. Client-observed TTFT p99 must be
+non-null, interactive requests must complete, the stream protocol must
+produce zero errors, and the SIGTERM drain must again exit 0 with the
+serving histograms present in the manifest's metrics snapshot.
+
+Exit code 0 = both phases hold. Any assertion prints what diverged.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BOOT_TIMEOUT_S = 240.0  # model init + first compile on a cold CPU runner
+
+
+class Server:
+    """One ``cli serve`` subprocess bound to an ephemeral port."""
+
+    def __init__(self, out_dir: Path, extra: list[str]) -> None:
+        self.out_dir = out_dir
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "introspective_awareness_tpu.cli", "serve",
+             "--model", "tiny", "--port", "0", "--output-dir", str(out_dir),
+             "--max-wall-s", "600", *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd=str(Path(__file__).resolve().parent.parent),
+        )
+        self.port = self._await_port()
+
+    def _await_port(self) -> int:
+        deadline = time.monotonic() + BOOT_TIMEOUT_S
+        assert self.proc.stdout is not None
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                raise AssertionError(
+                    f"server exited during boot (rc={self.proc.poll()})"
+                )
+            if line.startswith("serving on "):
+                return int(line.split(":")[-1].split()[0])
+        raise AssertionError("server never printed its port")
+
+    def sigterm_drain(self) -> dict:
+        """SIGTERM, assert exit 0, return the shutdown manifest."""
+        self.proc.send_signal(signal.SIGTERM)
+        rc = self.proc.wait(timeout=300)
+        assert rc == 0, f"SIGTERM drain exited {rc}, want 0"
+        man = json.loads((self.out_dir / "run_manifest.json").read_text())
+        assert man["clean_shutdown"] is True, man
+        return man
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+
+
+def steer(port: int, doc: dict, timeout_s: float = 300.0) -> dict:
+    """POST one request, drain its ndjson stream, return the terminal doc."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout_s)
+    try:
+        conn.request("POST", "/v1/steer", json.dumps(doc).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, f"{resp.status} {resp.read()[:200]!r}"
+        while True:
+            line = resp.readline()
+            assert line, "stream closed without a terminal line"
+            rec = json.loads(line)
+            if rec.get("done") or "error" in rec:
+                return rec
+    finally:
+        conn.close()
+
+
+def phase_preemption_identity(base: Path) -> dict:
+    print("[phase 1] preemption bit-identity over HTTP (temperature 0.7)")
+    srv = Server(base / "p1", [
+        "--slots", "1", "--max-new-tokens", "48", "--temperature", "0.7",
+        "--seed", "5", "--preempt-after-s", "0.05",
+    ])
+    try:
+        bulk_spec = {
+            "tenant": "sweep", "priority": "bulk",
+            "prompt": "a longer bulk prompt that holds the only slot",
+            "vector": "demo", "layer": 2, "strength": 2.0,
+            "max_new_tokens": 48, "temperature": 0.7,
+        }
+        inter_spec = {
+            "tenant": "chat", "priority": "interactive", "prompt": "hi",
+            "vector": "demo", "layer": 2, "strength": 2.0,
+            "max_new_tokens": 4, "temperature": 0.7,
+        }
+        victim = None
+        for attempt in range(4):  # pressure until a preemption lands
+            sid = 12000 + attempt
+            out: dict = {}
+            t = threading.Thread(
+                target=lambda: out.update(
+                    steer(srv.port, {**bulk_spec, "stream": sid})),
+            )
+            t.start()
+            time.sleep(0.2)  # let the bulk trial take the slot
+            done_i = steer(srv.port, inter_spec)
+            assert done_i.get("done"), f"interactive failed: {done_i}"
+            t.join(timeout=300)
+            assert out.get("done"), f"bulk never finished: {out}"
+            if out.get("preemptions", 0) >= 1:
+                victim = out
+                break
+            print(f"  attempt {attempt}: bulk finished unpreempted, retrying")
+        assert victim is not None, "no preemption landed in 4 attempts"
+
+        # Quiesced reference under the SAME stream id: must be identical.
+        ref = steer(srv.port, {**bulk_spec, "stream": victim["stream"]})
+        assert ref.get("done") and ref.get("preemptions", 0) == 0, ref
+        assert ref["text"] == victim["text"], (
+            f"preempted completion diverged from clean reference:\n"
+            f"  victim: {victim['text']!r}\n  ref:    {ref['text']!r}"
+        )
+        assert ref["n_tokens"] == victim["n_tokens"]
+
+        man = srv.sigterm_drain()
+        assert man["scheduler_stats"].get("preempted", 0) >= 1, man
+        print(f"[phase 1] OK: victim preempted {victim['preemptions']}x, "
+              f"completed bit-identically ({victim['n_tokens']} tokens); "
+              f"clean drain")
+        return {"preemptions": victim["preemptions"],
+                "n_tokens": victim["n_tokens"]}
+    finally:
+        srv.kill()
+
+
+def phase_loadgen(base: Path) -> dict:
+    from introspective_awareness_tpu.serve.loadgen import run_loadgen
+
+    print("[phase 2] two-tenant loadgen against a greedy server")
+    srv = Server(base / "p2", [
+        "--slots", "2", "--max-new-tokens", "24",
+        "--preempt-after-s", "0.1", "--quota-inflight", "4",
+        "--quota-queued", "4",
+    ])
+    try:
+        # Warm the decode path so TTFT percentiles measure steady state.
+        warm = steer(srv.port, {
+            "tenant": "chat", "prompt": "warm", "vector": "demo",
+            "layer": 2, "strength": 2.0, "max_new_tokens": 2,
+        })
+        assert warm.get("done"), warm
+        summary = run_loadgen(
+            "127.0.0.1", srv.port, duration_s=10.0,
+            interactive_clients=2, bulk_rate_hz=2.0, seed=3,
+            interactive_max_new=6, bulk_max_new=24,
+        )
+        print(f"  loadgen: {json.dumps(summary)}")
+        assert summary["ttft_p99_s"] is not None, summary
+        assert summary["completed_interactive"] >= 1, summary
+        assert summary["errors"] == 0, f"stream protocol errors: {summary}"
+
+        man = srv.sigterm_drain()
+        hists = man["metrics"]["metrics"]
+        assert "iat_serve_ttft_seconds" in hists, sorted(hists)
+        assert "iat_serve_itl_seconds" in hists, sorted(hists)
+        print(f"[phase 2] OK: {summary['completed_interactive']}i"
+              f"+{summary['completed_bulk']}b completed, ttft p99 "
+              f"{summary['ttft_p99_s']}s, {summary['rejected_429']}x 429; "
+              f"clean drain with histograms in manifest")
+        return summary
+    finally:
+        srv.kill()
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="serving_smoke_") as td:
+        base = Path(td)
+        ident = phase_preemption_identity(base)
+        load = phase_loadgen(base)
+
+    print(json.dumps({
+        "serving_smoke": "ok",
+        "victim_preemptions": ident["preemptions"],
+        "victim_tokens": ident["n_tokens"],
+        "ttft_p99_s": load["ttft_p99_s"],
+        "goodput_evals_per_s": load["serving_goodput_evals_per_s"],
+        "rejected_429": load["rejected_429"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,412 @@
+"""Steering-as-a-service subsystem: histogram quantile math, tenant label
+cardinality under reservation, quota backpressure, preemption with
+bit-identical resume, HTTP streaming end-to-end, journal-backed request
+recovery, and the cost-model paged routing satellite."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.obs.registry import (
+    MetricsRegistry,
+    bucket_quantile,
+)
+
+
+# -- histogram percentile / bucket math --------------------------------------
+
+
+class TestBucketQuantile:
+    def test_empty_is_none(self):
+        assert bucket_quantile((0.1, 1.0), [0, 0, 0], 0.5) is None
+
+    def test_out_of_range_q_raises(self):
+        with pytest.raises(ValueError):
+            bucket_quantile((1.0,), [1, 0], 1.5)
+        with pytest.raises(ValueError):
+            bucket_quantile((1.0,), [1, 0], -0.1)
+
+    def test_interpolation_inside_bucket(self):
+        # 10 observations all in (0.1, 1.0]: p50 interpolates to the middle
+        # of that bucket, from its lower edge 0.1.
+        v = bucket_quantile((0.1, 1.0), [0, 10, 0], 0.5)
+        assert v == pytest.approx(0.1 + 0.9 * 0.5)
+
+    def test_first_bucket_lower_edge_zero(self):
+        v = bucket_quantile((0.1, 1.0), [10, 0, 0], 0.5)
+        assert v == pytest.approx(0.05)
+
+    def test_inf_bucket_clamps_to_largest_finite(self):
+        # Everything overflowed: any quantile reads the top finite bound
+        # (a floor, matching histogram_quantile's convention).
+        assert bucket_quantile((0.1, 1.0), [0, 0, 7], 0.99) == 1.0
+
+    def test_rank_spanning_buckets(self):
+        # 4 in <=0.1, 4 in (0.1, 1.0]: p75 has rank 6 — 2 into the second
+        # bucket's 4 observations.
+        v = bucket_quantile((0.1, 1.0), [4, 4, 0], 0.75)
+        assert v == pytest.approx(0.1 + 0.9 * 0.5)
+
+    def test_histogram_quantile_and_count_methods(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat", buckets=(0.1, 1.0), labelnames=("priority",))
+        assert h.quantile(0.5, priority="interactive") is None
+        assert h.count(priority="interactive") == 0
+        for _ in range(10):
+            h.observe(0.5, priority="interactive")
+        assert h.count(priority="interactive") == 10
+        assert h.quantile(0.5, priority="interactive") == pytest.approx(0.55)
+        # Other label values keep their own series.
+        assert h.quantile(0.5, priority="bulk") is None
+
+
+class TestTenantLabelCardinality:
+    def test_tenant_burst_cannot_evict_reserved_series(self):
+        r = MetricsRegistry()
+        r.reserve_label_values("tenant", ["chat", "sweep"])
+        g = r.gauge("q", labelnames=("tenant",), max_series=4)
+        g.set(1.0, tenant="chat")
+        g.set(2.0, tenant="sweep")
+        for i in range(200):  # hostile tenant churn
+            g.set(float(i), tenant=f"anon{i}")
+        text = r.render_prometheus()
+        assert 'q{tenant="chat"} 1' in text
+        assert 'q{tenant="sweep"} 2' in text
+        assert 'q{tenant="other"}' in text
+        # The burst collapsed: only max_series unreserved series were
+        # admitted (and they did NOT displace the reserved ones above).
+        assert text.count('q{tenant="anon') == 4
+
+    def test_tenant_table_reserves_and_counts(self):
+        from introspective_awareness_tpu.serve.tenants import TenantTable
+
+        r = MetricsRegistry()
+        tt = TenantTable(max_inflight=1, max_queued=1,
+                         known_tenants=["chat"], registry=r)
+        assert tt.try_admit("chat") is None
+        retry = tt.try_admit("chat")  # queued budget exhausted
+        assert retry is not None and retry > 0
+        tt.on_start("chat")
+        tt.on_finish("chat")
+        assert tt.try_admit("chat") is None
+        assert r.value("iat_serve_rejected_total", tenant="chat") == 1.0
+
+
+# -- request parsing / vector store ------------------------------------------
+
+
+class TestRequestPlane:
+    def test_parse_round_trip_and_defaults(self):
+        from introspective_awareness_tpu.serve.request import parse_request
+
+        req = parse_request(json.dumps({
+            "prompt": "hello", "tenant": "t", "vector": "v",
+            "layer": 2, "strength": 3.5, "max_new_tokens": 7,
+            "stream": 42,
+        }).encode())
+        assert req.priority == "interactive" and req.stream == 42
+        assert req.layer == 2 and req.max_new_tokens == 7
+
+    def test_parse_rejects_garbage(self):
+        from introspective_awareness_tpu.serve.request import (
+            RequestError,
+            parse_request,
+        )
+
+        for body in (b"not json", b"[]", b"{}",
+                     json.dumps({"prompt": "x", "priority": "vip"}).encode(),
+                     json.dumps({"prompt": "x", "stream": -1}).encode()):
+            with pytest.raises(RequestError):
+                parse_request(body)
+
+    def test_vector_store_deterministic_across_instances(self):
+        from introspective_awareness_tpu.serve.request import VectorStore
+
+        a, b = VectorStore(16), VectorStore(16)
+        va, vb = a.get("calm"), b.get("calm")
+        np.testing.assert_array_equal(va, vb)
+        assert np.linalg.norm(va) == pytest.approx(1.0, abs=1e-5)
+        assert not np.array_equal(va, a.get("loud"))
+        reg = np.arange(16, dtype=np.float32)
+        a.register("mine", reg)
+        np.testing.assert_array_equal(a.get("mine"), reg)
+
+
+# -- live engine tests (tiny model) ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_runner():
+    import jax
+    import jax.numpy as jnp
+
+    from introspective_awareness_tpu.models.config import tiny_config
+    from introspective_awareness_tpu.models.tokenizer import ByteTokenizer
+    from introspective_awareness_tpu.models.transformer import init_params
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    cfg = tiny_config(n_layers=2)
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    return ModelRunner(params, cfg, ByteTokenizer(), model_name="tiny",
+                       seed=0)
+
+
+def _drain(stream, timeout=300.0):
+    """Read a ResponseStream to its terminal doc; returns (deltas, final)."""
+    deltas = []
+    while True:
+        doc = stream.q.get(timeout=timeout)
+        if doc.get("done") or "error" in doc:
+            return deltas, doc
+        deltas.append(doc["text"])
+
+
+def _bulk_req(stream_id, max_new=32):
+    from introspective_awareness_tpu.serve.request import SteerRequest
+
+    return SteerRequest(
+        rid=f"bulk-{stream_id}", tenant="sweep", priority="bulk",
+        prompt="a longer bulk prompt for decoding", vector="demo", layer=1,
+        strength=2.0, steer_start=0, max_new_tokens=max_new,
+        temperature=0.7, stream=stream_id,
+    )
+
+
+class TestServeEngine:
+    @pytest.mark.slow  # also proven every CI run by the serving-smoke lane
+    def test_preempted_bulk_completes_bit_identically(self, tiny_runner):
+        from introspective_awareness_tpu.serve.engine import ServeEngine
+        from introspective_awareness_tpu.serve.request import SteerRequest
+
+        # Engine A: one slot, hair-trigger SLO. The bulk trial holds the
+        # slot; an interactive arrival preempts it mid-decode. The tiny
+        # model decodes fast, so apply pressure until a preemption lands.
+        engA = ServeEngine(tiny_runner, slots=1, max_new_tokens=48,
+                           max_prompt_len=64, temperature=0.7, seed=5,
+                           preempt_after_s=0.05).start()
+        victim = None
+        for attempt in range(4):
+            stB = engA.submit(_bulk_req(777 + attempt, max_new=48))
+            time.sleep(0.25)
+            stI = engA.submit(SteerRequest(
+                rid=f"int{attempt}", tenant="chat", priority="interactive",
+                prompt="hi", vector="demo", layer=1, strength=2.0,
+                steer_start=0, max_new_tokens=4, temperature=0.7))
+            _, docI = _drain(stI)
+            assert docI.get("done")
+            _, docB = _drain(stB)
+            assert docB.get("done")
+            if docB["preemptions"] >= 1:
+                victim = docB
+                break
+        assert victim is not None, "no preemption landed in 4 attempts"
+        stats = engA.close()
+        assert stats["preempted"] >= 1
+
+        # Engine B: same seed, no contention — the reference decode under
+        # the same stream id must be bit-identical.
+        engB = ServeEngine(tiny_runner, slots=1, max_new_tokens=48,
+                           max_prompt_len=64, temperature=0.7,
+                           seed=5).start()
+        _, ref = _drain(engB.submit(_bulk_req(victim["stream"], max_new=48)))
+        engB.close()
+        assert ref["text"] == victim["text"]
+        assert ref["n_tokens"] == victim["n_tokens"]
+
+    def test_interactive_streams_incremental_text(self, tiny_runner):
+        from introspective_awareness_tpu.serve.engine import ServeEngine
+        from introspective_awareness_tpu.serve.request import SteerRequest
+
+        eng = ServeEngine(tiny_runner, slots=2, max_new_tokens=8,
+                          max_prompt_len=64, seed=3).start()
+        st = eng.submit(SteerRequest(
+            rid="s1", tenant="chat", priority="interactive",
+            prompt="hello world", vector="demo", layer=1, strength=2.0,
+            steer_start=0, max_new_tokens=8, temperature=0.0))
+        deltas, final = _drain(st)
+        eng.close()
+        assert final.get("done") and final["n_tokens"] >= 1
+        # Streamed deltas concatenate to the final text (byte tokenizer;
+        # multibyte boundary garble is possible in principle but the
+        # decoded stream must at least be non-empty for a non-empty final).
+        if final["text"]:
+            assert deltas
+
+    def test_quota_429_and_draining_reject(self, tiny_runner):
+        from introspective_awareness_tpu.serve.engine import ServeEngine
+        from introspective_awareness_tpu.serve.request import (
+            QuotaError,
+            RequestError,
+        )
+        from introspective_awareness_tpu.serve.tenants import TenantTable
+
+        reg = MetricsRegistry()
+        eng = ServeEngine(
+            tiny_runner, slots=1, max_new_tokens=8, max_prompt_len=64,
+            tenants=TenantTable(max_inflight=1, max_queued=1, registry=reg),
+            registry=reg,
+        )
+        # No scheduler started: requests stay queued, so quotas bind.
+        eng.submit(_bulk_req0(1))
+        with pytest.raises(QuotaError) as ei:
+            eng.submit(_bulk_req0(2))
+        assert ei.value.retry_after_s > 0
+        eng._accepting = False
+        with pytest.raises(RequestError):
+            eng.submit(_bulk_req0(3, tenant="other"))
+
+    @pytest.mark.slow
+    def test_journal_recovery_reenqueues_pending(self, tiny_runner, tmp_path):
+        from introspective_awareness_tpu.runtime.journal import TrialJournal
+        from introspective_awareness_tpu.serve.engine import ServeEngine
+
+        cfg = {"kind": "serve", "model": "tiny", "seed": 5,
+               "temperature": 0.7, "max_new_tokens": 32}
+        j1 = TrialJournal(tmp_path / "req.jsonl", cfg)
+        eng1 = ServeEngine(tiny_runner, slots=1, max_new_tokens=32,
+                           max_prompt_len=64, temperature=0.7, seed=5,
+                           journal=j1)
+        # Accept but never start the scheduler — the "crash" leaves the
+        # request journaled as accepted-but-unfinished.
+        eng1.submit(_bulk_req(777))
+        j1.close()
+
+        j2 = TrialJournal(tmp_path / "req.jsonl", cfg)
+        assert list(j2.pending_requests()) == ["bulk-777"]
+        eng2 = ServeEngine(tiny_runner, slots=1, max_new_tokens=32,
+                           max_prompt_len=64, temperature=0.7, seed=5,
+                           journal=j2)
+        assert eng2.recover() == 1
+        eng2.start()
+        # The recovered request completes under its journaled stream id
+        # and matches the clean reference decode.
+        deadline = time.monotonic() + 300
+        while j2.pending_requests() and time.monotonic() < deadline:
+            time.sleep(0.25)
+        assert not j2.pending_requests()
+        eng2.close()
+        j2.close()
+
+        engR = ServeEngine(tiny_runner, slots=1, max_new_tokens=32,
+                           max_prompt_len=64, temperature=0.7,
+                           seed=5).start()
+        _, ref = _drain(engR.submit(_bulk_req(777)))
+        engR.close()
+        j3 = TrialJournal(tmp_path / "req.jsonl", cfg)
+        done = j3._request_done["bulk-777"]
+        j3.close()
+        assert done["n_tokens"] == ref["n_tokens"]
+
+
+def _bulk_req0(stream_id, tenant="sweep"):
+    """Greedy bulk request (matches engines built with temperature=0)."""
+    from introspective_awareness_tpu.serve.request import SteerRequest
+
+    return SteerRequest(
+        rid=f"b{stream_id}", tenant=tenant, priority="bulk",
+        prompt="bulk prompt", vector="demo", layer=1, strength=2.0,
+        steer_start=0, max_new_tokens=8, temperature=0.0, stream=stream_id,
+    )
+
+
+# -- HTTP plane ---------------------------------------------------------------
+
+
+class TestServeHTTP:
+    def test_stream_and_observability_routes(self, tiny_runner):
+        import http.client
+
+        from introspective_awareness_tpu.serve.engine import ServeEngine
+        from introspective_awareness_tpu.serve.server import ServeServer
+
+        reg = MetricsRegistry()
+        eng = ServeEngine(tiny_runner, slots=2, max_new_tokens=8,
+                          max_prompt_len=64, seed=1, registry=reg).start()
+        srv = ServeServer(eng, port=0, registry=reg).start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=300)
+            conn.request(
+                "POST", "/v1/steer",
+                json.dumps({"tenant": "chat", "prompt": "hello",
+                            "vector": "demo", "layer": 1, "strength": 2.0,
+                            "max_new_tokens": 6}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            final = None
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                doc = json.loads(line)
+                if doc.get("done") or "error" in doc:
+                    final = doc
+                    break
+            conn.close()
+            assert final and final.get("done") and final["n_tokens"] >= 1
+
+            c2 = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+            c2.request("GET", "/metrics")
+            text = c2.getresponse().read().decode()
+            c2.close()
+            assert "iat_serve_ttft_seconds" in text
+            assert "iat_serve_requests_completed_total" in text
+
+            c3 = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+            c3.request("POST", "/v1/steer", b"not json",
+                       headers={"Content-Type": "application/json"})
+            assert c3.getresponse().status == 400
+            c3.close()
+        finally:
+            srv.stop()
+            eng.close()
+
+
+# -- satellite: cost-model paged routing --------------------------------------
+
+
+class TestPagedRouteCostModel:
+    def test_tie_stays_classic_and_families_go_paged(self, tiny_runner):
+        s0 = np.zeros(4, np.float32)
+        pre = list(range(100, 164))
+        rows_shared = [pre + [i, i + 1] for i in range(4)]
+        use, info = tiny_runner._paged_route(rows_shared, s0, None, 64)
+        assert not use and info["decision"] == "classic"
+        assert info["classic_prefill_tokens"] == info["paged_prefill_tokens_est"]
+
+        famA = list(range(1, 65))
+        famB = list(range(200, 264))
+        rows_fam = [famA + [9, 9], famA + [8, 8],
+                    famB + [7, 7], famB + [6, 6]]
+        use2, info2 = tiny_runner._paged_route(rows_fam, s0, None, 0)
+        assert use2 and info2["shared_tokens_est"] == 128
+        assert info2["paged_prefill_tokens_est"] < info2["classic_prefill_tokens"]
+
+    def test_steered_rows_share_nothing_past_steer_start(self, tiny_runner):
+        s = np.asarray([2.0, 2.0], np.float32)
+        fam = list(range(1, 65))
+        rows = [fam + [1, 2], fam + [3, 4]]
+        # Steering from token 16 caps sharing at one page.
+        use, info = tiny_runner._paged_route(rows, s, [16, 16], 0)
+        assert info["shared_tokens_est"] == 16
+        # Whole-prompt steering (start None) shares nothing.
+        _, info2 = tiny_runner._paged_route(rows, s, [None, None], 0)
+        assert info2["shared_tokens_est"] == 0
+
+    def test_decision_lands_in_last_autotune(self, tiny_runner):
+        out = tiny_runner.generate_grid_scheduled(
+            ["prompt one shared", "prompt two shared"],
+            [1, 1],
+            [np.zeros(tiny_runner.cfg.hidden_size, np.float32)] * 2,
+            [0.0, 0.0], max_new_tokens=4, slots=2,
+        )
+        assert len(out) == 2
+        route = (tiny_runner.last_autotune or {}).get("kv_route")
+        assert route is not None
+        assert route["decision"] in ("paged", "classic")
+        assert route["classic_prefill_tokens"] >= 0
