@@ -278,6 +278,16 @@ def build_parser() -> argparse.ArgumentParser:
                              "grading windows) to this path at sweep end; "
                              "open it at https://ui.perfetto.dev. Requires "
                              "--scheduler continuous.")
+    parser.add_argument("--roofline", action="store_true",
+                        help="Attach the device-measurement plane to the "
+                             "continuous scheduler: per-executable "
+                             "FLOPs/HBM-byte costs from compile-time cost "
+                             "analysis, live iat_*_util_frac gauges, and a "
+                             "'roofline' block (achieved vs peak, bound-by "
+                             "classification) in run_manifest.json. Costs "
+                             "one extra AOT compile per executable; decoded "
+                             "tokens are unchanged. Requires --scheduler "
+                             "continuous.")
     parser.add_argument("--inject-faults", type=str, default=None,
                         help="Deterministic fault injection for testing "
                              "recovery (also via IAT_FAULTS env): comma "

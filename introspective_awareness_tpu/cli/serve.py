@@ -52,6 +52,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-cache-dtype", default="model")
     p.add_argument("--max-wall-s", type=float, default=0.0,
                    help="self-terminate after this many seconds (tests)")
+    p.add_argument("--trace", action="store_true",
+                   help="attach the chunk flight recorder; serves the "
+                        "timeline at GET /trace and writes "
+                        "trace_serve.json on drain")
+    p.add_argument("--roofline", action="store_true",
+                   help="capture per-executable FLOPs/HBM costs and embed "
+                        "the roofline block in the drain manifest "
+                        "(one extra compile per executable)")
     return p
 
 
@@ -85,6 +93,17 @@ def main(argv: Optional[list[str]] = None) -> int:
             "max_new_tokens": int(args.max_new_tokens),
         })
 
+    trace = None
+    if args.trace:
+        from introspective_awareness_tpu.obs.trace import ChunkTrace
+
+        trace = ChunkTrace()
+    meter = None
+    if args.roofline:
+        from introspective_awareness_tpu.obs.roofline import RooflineMeter
+
+        meter = RooflineMeter(registry=registry, replica="serve")
+
     known = [t for t in str(args.tenants).split(",") if t]
     engine = ServeEngine(
         runner,
@@ -102,6 +121,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         ),
         journal=journal,
         registry=registry,
+        trace=trace,
+        roofline=meter,
     )
     n_recovered = engine.recover()
     engine.start()
@@ -116,9 +137,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         "scheduler",
         lambda: ("crashed" if engine._loop_error is not None else None),
     )
+    from introspective_awareness_tpu.obs.profiler import ProfilerPlane
+
+    profiler = ProfilerPlane(out_dir / "profiles")
     server = ServeServer(
         engine, port=args.port, host=args.host,
         registry=registry, health=health,
+        profiler=profiler, trace_source=trace,
     ).start()
 
     stop = threading.Event()
@@ -156,6 +181,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         "scheduler_stats": stats,
         "metrics": registry.snapshot(),
     }
+    if trace is not None:
+        manifest["trace"] = trace.summary()
+        trace.save_perfetto(str(out_dir / "trace_serve.json"))
+    if meter is not None:
+        manifest["roofline"] = meter.block(trace=trace)
     (out_dir / "run_manifest.json").write_text(
         json.dumps(manifest, indent=2, default=str)
     )

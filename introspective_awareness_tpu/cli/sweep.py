@@ -109,6 +109,12 @@ def _build_judge(args, mesh, rules):
         return None
     if args.judge_backend == "on-device":
         grader = load_subject(args.judge_model, args, mesh, rules)
+        meter = getattr(args, "_roofline", None)
+        if meter is not None:
+            # Judge decodes ride the fixed-batch path; prefix the rows so
+            # the roofline block separates grader cost from subject cost.
+            grader.roofline = meter
+            grader.roofline_prefix = "judge_"
         return LLMJudge(client=OnDeviceJudgeClient(grader, max_tokens=500))
     try:
         return LLMJudge(client=OpenAIJudgeClient(model=args.judge_model))
@@ -426,6 +432,7 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
     faults = getattr(args, "_faults", None)
     breaker = getattr(args, "_judge_breaker", None)
     trace = getattr(args, "_trace", None)
+    roofline = getattr(args, "_roofline", None)
     progress = getattr(args, "_progress", None)
     fabric = getattr(args, "_fabric", None)
 
@@ -575,7 +582,7 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                 grade_pool=_make_pool(pass_key),
                 journal=journal, pass_key=pass_key,
                 stop_event=stop_event, faults=faults, trace=trace,
-                fabric=fabric,
+                roofline=roofline, fabric=fabric,
             )
             if progress is not None and fabric is None:
                 # The fabric's per-replica trackers already counted these.
@@ -640,7 +647,7 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
                     grade_pool=_make_pool(pass_key),
                     journal=journal, pass_key=pass_key,
                     stop_event=stop_event, faults=faults, trace=trace,
-                    fabric=fabric,
+                    roofline=roofline, fabric=fabric,
                     **common,
                 )
                 results += out
@@ -901,6 +908,7 @@ def _write_manifest(
     out_base.mkdir(parents=True, exist_ok=True)
     mesh = runner.mesh
     trace = getattr(args, "_trace", None)
+    roofline = getattr(args, "_roofline", None)
     manifest = {
         "model": runner.model_name,
         "n_layers": runner.n_layers,
@@ -928,6 +936,12 @@ def _write_manifest(
         # attribution summary when --trace-out was active.
         "metrics": default_registry().snapshot(),
         "trace": trace.summary() if trace is not None else None,
+        # Device-measurement plane (--roofline): per-executable achieved
+        # vs peak rows, joined with the trace's device-time attribution
+        # when both planes ran.
+        "roofline": (
+            roofline.block(trace=trace) if roofline is not None else None
+        ),
         "ledger_path": getattr(runner.ledger, "path", None),
         "hbm_budget_frac": getattr(args, "hbm_budget_frac", None),
         "prefill_chunks": [
@@ -1174,6 +1188,20 @@ def main(argv: Optional[list[str]] = None) -> int:
         )
     args._ledger = ledger
 
+    # The roofline meter must exist before the judge is built: an
+    # on-device judge hooks the same meter (prefixed rows) at load time.
+    args._roofline = None
+    if getattr(args, "roofline", False):
+        if args.scheduler != "continuous":
+            print(
+                "note: --roofline requires --scheduler continuous; "
+                "no roofline will be recorded"
+            )
+        else:
+            from introspective_awareness_tpu.obs import RooflineMeter
+
+            args._roofline = RooflineMeter()
+
     judge = _build_judge(args, mesh, rules)
     if judge is not None:
         judge.ledger = ledger
@@ -1247,8 +1275,17 @@ def main(argv: Optional[list[str]] = None) -> int:
         metrics_port = 0
     args._metrics_url = None
     if metrics_port is not None:
+        from introspective_awareness_tpu.obs import ProfilerPlane
+
         metrics_server = MetricsServer(
-            progress=progress, port=metrics_port, health=health
+            progress=progress, port=metrics_port, health=health,
+            # On-demand XPlane capture (GET /profile?duration_ms=...)
+            # into the run dir, and the live flight-recorder timeline
+            # (GET /trace) when --trace-out is active.
+            profiler=ProfilerPlane(
+                str(Path(args.output_dir) / "profiles")
+            ),
+            trace_source=args._trace,
         ).start()
         args._metrics_url = metrics_server.url
         print(

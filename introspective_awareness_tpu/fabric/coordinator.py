@@ -26,7 +26,9 @@ failure plane:
   coordinator's ``/metrics`` and ``/progress`` pull each host's
   ``/registry``/``/progress`` and serve the fleet view (per-host series
   re-labeled ``host="<h>"``), with last-good caching when a host scrape
-  fails.
+  fails. ``GET /timeline`` pulls each host's ``/trace`` Perfetto export
+  and merges them onto one wall-clock-anchored axis (processes labeled
+  ``host<h>/...``) — the fleet's decode as a single openable timeline.
 
 ``RemoteQueue`` is the worker-host facade: it speaks this protocol but
 exposes the in-process queue surface (``acquire``/``complete``/``fail``
@@ -54,6 +56,7 @@ from typing import Callable, Optional
 
 from introspective_awareness_tpu.obs.http import PROM_CONTENT_TYPE
 from introspective_awareness_tpu.obs.registry import render_federated
+from introspective_awareness_tpu.obs.trace import merge_timelines
 from introspective_awareness_tpu.runtime.journal import (
     JournalError,
     SweepInterrupted,
@@ -442,6 +445,21 @@ class CoordinatorService:
                 }
         return out
 
+    def federated_timeline(self) -> dict:
+        """One Perfetto doc merging every registered host's ``/trace``
+        export (last-good cached like the other federated pulls). Each
+        host's processes come back labeled ``host<h>/...`` and shifted
+        onto a common axis by the wall-clock anchor
+        (``metadata.unix_base_s``) its trace carries — the same
+        "beg"-anchored chain the single-host exporter uses, so a
+        multi-host decode reads as one timeline."""
+        docs = []
+        for host in sorted(self.hosts):
+            doc = self._pull_host(host, "/trace")
+            if doc is not None:
+                docs.append((f"host{host}", doc))
+        return merge_timelines(docs)
+
     def close(self) -> None:
         if self._wal is not None and not self._wal.closed:
             self._wal.flush()
@@ -472,10 +490,14 @@ class CoordinatorServer:
             return (200, "application/json",
                     json.dumps(service.federated_progress()).encode())
 
+        def _timeline() -> tuple[int, str, bytes]:
+            return (200, "application/json",
+                    json.dumps(service.federated_timeline()).encode())
+
         self._server = RpcTransportServer(
             service.handle,
             get_routes={"/healthz": _healthz, "/metrics": _metrics,
-                        "/progress": _progress},
+                        "/progress": _progress, "/timeline": _timeline},
             host=host, port=port, on_request=self._tick,
         )
 

@@ -50,6 +50,7 @@ import zlib
 from typing import Optional, Sequence
 
 from introspective_awareness_tpu.obs.registry import default_registry
+from introspective_awareness_tpu.obs.trace import ChunkTrace, merge_timelines
 from introspective_awareness_tpu.runtime.journal import SweepInterrupted
 
 from .coordinator import RemoteQueue
@@ -103,6 +104,7 @@ class SweepFabric:
         self.progress = progress
         self.partitions = partitions
         self.last_stats: dict = {}
+        self.replica_traces: list[ChunkTrace] = []
         self._passes = 0
 
         self.coordinator_url = coordinator_url
@@ -208,6 +210,7 @@ class SweepFabric:
         stop_event=None,
         faults=None,
         trace=None,
+        roofline=None,
         partitions: Optional[Sequence[Sequence[int]]] = None,
         trial_keys: Optional[Sequence[str]] = None,
         pass_name: Optional[str] = None,
@@ -238,6 +241,16 @@ class SweepFabric:
 
         R = self.n_replicas
         lease = self.lease_size or max(1, int(slots))
+        # Per-replica flight recorders: replica 0 reuses the caller's trace
+        # (the primary timeline the sweep owns and writes to --trace-out);
+        # every other replica records into its own fresh ring so
+        # merged_timeline() can export one labeled lane per replica.
+        if trace is not None:
+            self.replica_traces = [trace] + [
+                ChunkTrace(capacity=trace.capacity) for _ in range(R - 1)
+            ]
+        else:
+            self.replica_traces = []
         out: list[Optional[str]] = [None] * N
         abort = threading.Event()
         cb_lock = threading.Lock()
@@ -330,9 +343,12 @@ class SweepFabric:
                 trial_ids=[ids[p] for p in sub],
                 stop_event=stop_event,
                 faults=self._faults_for(faults, worker.replica_id),
-                # The flight recorder is not replica-aware; replica 0 keeps
-                # the timeline, others decode untraced.
-                trace=trace if worker.replica_id == 0 else None,
+                trace=(self.replica_traces[worker.replica_id]
+                       if self.replica_traces else None),
+                # The roofline meter's per-kind accumulators are not
+                # thread-safe; the primary replica carries it alone (its
+                # executables are the fleet's — identical compiled costs).
+                roofline=roofline if worker.replica_id == 0 else None,
             )
             for j, p in enumerate(sub):
                 out[p] = texts[j]
@@ -386,6 +402,16 @@ class SweepFabric:
                 f"error — lease accounting bug"
             )
         return out  # type: ignore[return-value]
+
+    def merged_timeline(self) -> dict:
+        """One Perfetto doc covering every replica's flight recorder from
+        the last traced pass, each replica's processes labeled
+        ``replica{k}/...`` and aligned on the shared wall-clock anchor
+        (``unix_anchor``). Empty doc if the last pass ran untraced."""
+        return merge_timelines([
+            (f"replica{k}", t.to_perfetto(label=f"replica{k}"))
+            for k, t in enumerate(self.replica_traces)
+        ])
 
     # -- internals -----------------------------------------------------------
 

@@ -32,6 +32,13 @@ Four pieces, threaded through runner / sweep / judge / bench / scripts:
 - :mod:`~introspective_awareness_tpu.obs.regress` — the bench-trajectory
   regression gate over the committed ``BENCH_r*.json`` history
   (``scripts/perf_gate.py`` / the CI perf-gate job).
+- :mod:`~introspective_awareness_tpu.obs.cost` +
+  :mod:`~introspective_awareness_tpu.obs.roofline` +
+  :mod:`~introspective_awareness_tpu.obs.profiler` — the device-
+  measurement plane: per-executable compile-time FLOPs/HBM-bytes
+  capture, the roofline join against a calibrated per-chip peak table
+  (continuous ``iat_*_util_frac`` gauges + ``roofline`` blocks in bench
+  and manifests), and on-demand XPlane capture behind ``/profile``.
 """
 
 from introspective_awareness_tpu.obs.compile_stats import CompileAccounting
@@ -78,13 +85,29 @@ from introspective_awareness_tpu.obs.registry import (
     default_registry,
     render_federated,
 )
-from introspective_awareness_tpu.obs.trace import ChunkTrace, format_attribution
+from introspective_awareness_tpu.obs.cost import ExecutableCostIndex
+from introspective_awareness_tpu.obs.profiler import (
+    ProfilerBusy,
+    ProfilerError,
+    ProfilerPlane,
+    ProfilerRateLimited,
+)
+from introspective_awareness_tpu.obs.roofline import (
+    RooflineMeter,
+    device_peaks,
+)
+from introspective_awareness_tpu.obs.trace import (
+    ChunkTrace,
+    format_attribution,
+    merge_timelines,
+)
 
 __all__ = [
     "AggregateProgress",
     "AutotuneResult",
     "ChunkTrace",
     "CompileAccounting",
+    "ExecutableCostIndex",
     "HbmPreflightError",
     "HealthState",
     "MetricsRegistry",
@@ -97,12 +120,19 @@ __all__ = [
     "RecoveryGauges",
     "StagedGauges",
     "PreflightReport",
+    "ProfilerBusy",
+    "ProfilerError",
+    "ProfilerPlane",
+    "ProfilerRateLimited",
+    "RooflineMeter",
     "RunLedger",
     "Span",
     "Timings",
     "autotune",
     "default_registry",
+    "device_peaks",
     "format_attribution",
+    "merge_timelines",
     "device_hbm_bytes",
     "enable_compilation_cache",
     "enable_debug_checks",

@@ -1,0 +1,150 @@
+"""Per-executable compile-time cost capture (the device-measurement
+plane's static half).
+
+Every scheduled-decode executable is a named ``jax.jit`` function
+(``runtime.generate.EXECUTABLES`` / ``runtime.paged.PAGED_EXECUTABLES``).
+:class:`ExecutableCostIndex` captures, once per name, what the compiler
+knows about one dispatch of it — FLOPs, HBM bytes accessed, output bytes
+(``compiled.cost_analysis()``) and the argument/output/temp/code
+footprint (``compiled.memory_analysis()``) — by AOT-lowering the exact
+call the scheduler is about to dispatch. Lowering traces avals only, so
+capture is safe immediately before a call whose buffers are donated.
+
+The capture costs one extra compile per executable per process (the AOT
+executable and the traced-call executable are cached separately), which
+is why the scheduler only captures when a roofline meter is attached
+(``roofline=`` opt-in) — never on the default path.
+
+``cost_analysis`` availability varies by backend; on any failure the
+entry is recorded with zeros and ``cost_available: False`` so the
+roofline join degrades to counting dispatches instead of crashing a
+sweep. ``record()`` lets tests (and backends with no cost model at all)
+seed synthetic entries with known FLOPs/bytes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+__all__ = ["ExecutableCostIndex"]
+
+
+def _first_computation(cost: Any) -> dict:
+    """``cost_analysis()`` returns a dict on recent jax, a list of
+    per-computation dicts on older releases; normalize to one dict."""
+    if isinstance(cost, (list, tuple)):
+        return dict(cost[0]) if cost else {}
+    return dict(cost) if cost else {}
+
+
+class ExecutableCostIndex:
+    """Name-keyed table of per-dispatch executable costs."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[str, dict[str, Any]] = {}
+
+    # -- capture -----------------------------------------------------------
+
+    def capture(self, name: str, fn: Any, *args: Any, **kwargs: Any) -> dict:
+        """AOT-compile ``fn(*args, **kwargs)`` and record its cost under
+        ``name``. Idempotent: a name already present is returned as-is
+        (the first dispatch's shapes define the entry)."""
+        with self._lock:
+            if name in self._entries:
+                return self._entries[name]
+        entry = self._analyze(name, fn, args, kwargs)
+        with self._lock:
+            return self._entries.setdefault(name, entry)
+
+    @staticmethod
+    def _analyze(name: str, fn: Any, args: tuple, kwargs: dict) -> dict:
+        entry: dict[str, Any] = {
+            "name": name,
+            "flops": 0.0,
+            "hbm_bytes": 0.0,
+            "output_bytes": 0.0,
+            "arg_bytes": 0.0,
+            "temp_bytes": 0.0,
+            "code_bytes": 0.0,
+            "cost_available": False,
+            "source": "compiled",
+            "error": None,
+        }
+        try:
+            compiled = fn.lower(*args, **kwargs).compile()
+        except Exception as e:  # noqa: BLE001 — capture must never crash a run
+            entry["source"] = "error"
+            entry["error"] = f"{type(e).__name__}: {e}"
+            return entry
+        try:
+            cost = _first_computation(compiled.cost_analysis())
+            entry["flops"] = float(cost.get("flops", 0.0))
+            entry["hbm_bytes"] = float(cost.get("bytes accessed", 0.0))
+            entry["output_bytes"] = float(
+                cost.get("bytes accessedout{}", 0.0)
+            )
+            entry["cost_available"] = bool(cost)
+        except Exception as e:  # noqa: BLE001
+            entry["error"] = f"{type(e).__name__}: {e}"
+        try:
+            mem = compiled.memory_analysis()
+            if mem is not None:
+                entry["arg_bytes"] = float(
+                    getattr(mem, "argument_size_in_bytes", 0) or 0
+                )
+                entry["output_bytes"] = entry["output_bytes"] or float(
+                    getattr(mem, "output_size_in_bytes", 0) or 0
+                )
+                entry["temp_bytes"] = float(
+                    getattr(mem, "temp_size_in_bytes", 0) or 0
+                )
+                entry["code_bytes"] = float(
+                    getattr(mem, "generated_code_size_in_bytes", 0) or 0
+                )
+        except Exception:  # noqa: BLE001 — memory stats are best-effort
+            pass
+        return entry
+
+    def record(self, name: str, *, flops: float = 0.0,
+               hbm_bytes: float = 0.0, output_bytes: float = 0.0,
+               source: str = "synthetic") -> dict:
+        """Seed a synthetic entry (tests; backends without a cost model)."""
+        entry = {
+            "name": name,
+            "flops": float(flops),
+            "hbm_bytes": float(hbm_bytes),
+            "output_bytes": float(output_bytes),
+            "arg_bytes": 0.0,
+            "temp_bytes": 0.0,
+            "code_bytes": 0.0,
+            "cost_available": True,
+            "source": source,
+            "error": None,
+        }
+        with self._lock:
+            self._entries[name] = entry
+        return entry
+
+    # -- lookup ------------------------------------------------------------
+
+    def get(self, name: str) -> Optional[dict]:
+        with self._lock:
+            return self._entries.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {"entries": {k: dict(v) for k, v in self._entries.items()}}

@@ -114,17 +114,25 @@ class ProgressTracker:
             elapsed = time.perf_counter() - self._t0
             extra = dict(self._extra)
             probes = dict(self._probes)
-        rate = done / elapsed if elapsed > 0 and done else 0.0
+        # Zero completions means zero rate and a NULL ETA — never a
+        # division by (or extrapolation from) a zero rate. The guard is
+        # explicit on `done` so the contract survives refactors of the
+        # rate expression.
+        if done > 0 and elapsed > 0:
+            rate = done / elapsed
+        else:
+            rate = 0.0
+        if done > 0 and rate > 0 and total > done:
+            eta = round((total - done) / rate, 1)
+        else:
+            eta = None
         out: dict[str, Any] = {
             "trials_total": total,
             "trials_done": done,
             "phase": phase,
             "elapsed_s": round(elapsed, 3),
             "evals_per_s": round(rate, 4),
-            "eta_s": (
-                round((total - done) / rate, 1)
-                if rate > 0 and total > done else None
-            ),
+            "eta_s": eta,
             "unix_time": time.time(),
         }
         out.update(extra)
@@ -242,12 +250,63 @@ def handle_observability_get(
     registry: MetricsRegistry,
     progress: Optional[ProgressTracker],
     health: HealthState,
+    profiler: Optional[Any] = None,
+    trace_source: Optional[Any] = None,
+    query: str = "",
 ) -> bool:
     """Serve the shared observability GET routes (``/metrics``,
-    ``/progress``, ``/registry``, ``/healthz``) on any stdlib handler.
-    Returns False when ``path`` is not an observability route, so callers
-    (e.g. the serving front-end, which multiplexes these onto its request
-    port) can fall through to their own routing."""
+    ``/progress``, ``/registry``, ``/healthz``, plus ``/profile`` when a
+    :class:`~introspective_awareness_tpu.obs.profiler.ProfilerPlane` is
+    wired and ``/trace`` when a live ChunkTrace — or a zero-arg callable
+    returning a Perfetto doc — is) on any stdlib handler. Returns False
+    when ``path`` is not an observability route, so callers (e.g. the
+    serving front-end, which multiplexes these onto its request port)
+    can fall through to their own routing."""
+    if path == "/profile" and profiler is not None:
+        from urllib.parse import parse_qs
+
+        from introspective_awareness_tpu.obs.profiler import (
+            ProfilerBusy,
+            ProfilerError,
+            ProfilerRateLimited,
+        )
+
+        raw = parse_qs(query).get("duration_ms", [None])[0]
+        try:
+            duration_ms = int(raw) if raw is not None else None
+        except ValueError:
+            send_http(handler, 400, "application/json",
+                      json.dumps({"error": "bad duration_ms"}).encode()
+                      + b"\n")
+            return True
+        try:
+            doc = profiler.capture(duration_ms)
+        except ProfilerBusy as e:
+            send_http(handler, 503, "application/json",
+                      json.dumps({"error": str(e)}).encode() + b"\n")
+        except ProfilerRateLimited as e:
+            send_http(
+                handler, 429, "application/json",
+                json.dumps({"error": str(e),
+                            "retry_after_s": e.retry_after_s}).encode()
+                + b"\n",
+                extra_headers={
+                    "Retry-After": max(1, int(e.retry_after_s))
+                },
+            )
+        except ProfilerError as e:
+            send_http(handler, 500, "application/json",
+                      json.dumps({"error": str(e)}).encode() + b"\n")
+        else:
+            send_http(handler, 200, "application/json",
+                      json.dumps(doc).encode() + b"\n")
+        return True
+    if path == "/trace" and trace_source is not None:
+        doc = (trace_source() if callable(trace_source)
+               else trace_source.to_perfetto())
+        send_http(handler, 200, "application/json",
+                  json.dumps(doc).encode() + b"\n")
+        return True
     if path == "/metrics":
         send_http(handler, 200, PROM_CONTENT_TYPE,
                   registry.render_prometheus().encode())
@@ -275,10 +334,14 @@ class MetricsServer:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  progress: Optional[ProgressTracker] = None,
                  port: int = 0, host: str = "127.0.0.1",
-                 health: Optional[HealthState] = None) -> None:
+                 health: Optional[HealthState] = None,
+                 profiler: Optional[Any] = None,
+                 trace_source: Optional[Any] = None) -> None:
         self.registry = registry if registry is not None else default_registry()
         self.progress = progress
         self.health = health if health is not None else HealthState()
+        self.profiler = profiler
+        self.trace_source = trace_source
         self._host = host
         self._want_port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -296,7 +359,8 @@ class MetricsServer:
 
     def start(self) -> "MetricsServer":
         registry, progress = self.registry, self.progress
-        health = self.health
+        health, profiler = self.health, self.profiler
+        trace_source = self.trace_source
 
         class _Handler(BaseHTTPRequestHandler):
             def log_message(self, *a: Any) -> None:  # silence stderr spam
@@ -310,9 +374,13 @@ class MetricsServer:
                 self.wfile.write(body)
 
             def do_GET(self) -> None:
-                path = self.path.split("?", 1)[0]
+                parts = self.path.split("?", 1)
+                path = parts[0]
+                query = parts[1] if len(parts) > 1 else ""
                 if not handle_observability_get(
-                    self, path, registry, progress, health
+                    self, path, registry, progress, health,
+                    profiler=profiler, trace_source=trace_source,
+                    query=query,
                 ):
                     self._send(404, "text/plain", b"not found\n")
 

@@ -1,0 +1,125 @@
+"""On-demand programmatic XPlane capture behind ``/profile``.
+
+``jax.profiler`` writes TensorBoard-loadable XPlane protobufs; this
+module wraps ``start_trace``/``stop_trace`` into a capture object the
+HTTP plane can drive safely while a sweep runs:
+
+- one capture at a time — a second request while a window is open gets
+  :class:`ProfilerBusy` (the endpoint maps it to 503);
+- rate-limited — captures closer together than ``min_interval_s`` get
+  :class:`ProfilerRateLimited` with a retry hint (429 + Retry-After),
+  so a dashboard refresh loop cannot turn the profiler into a workload;
+- bounded — ``duration_ms`` is clamped to ``max_duration_ms``.
+
+Artifacts land under ``out_dir/xplane_<n>/`` (the run directory), and
+the returned doc lists every file captured so the caller can fetch or
+``xprof``/TensorBoard them. A marker op runs inside every window so
+even an idle process produces a non-empty capture (the CI smoke's
+assertion).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+from typing import Any, Optional
+
+__all__ = [
+    "ProfilerBusy",
+    "ProfilerError",
+    "ProfilerPlane",
+    "ProfilerRateLimited",
+]
+
+
+class ProfilerError(RuntimeError):
+    """Capture failed (backend refused to trace, unwritable dir, ...)."""
+
+
+class ProfilerBusy(ProfilerError):
+    """A capture window is already open."""
+
+
+class ProfilerRateLimited(ProfilerError):
+    """Too soon after the previous capture."""
+
+    def __init__(self, retry_after_s: float) -> None:
+        super().__init__(
+            f"profiler rate limit: retry in {retry_after_s:.1f}s"
+        )
+        self.retry_after_s = float(retry_after_s)
+
+
+class ProfilerPlane:
+    """One process-wide XPlane capture gate."""
+
+    def __init__(self, out_dir: str, *, min_interval_s: float = 10.0,
+                 max_duration_ms: int = 10_000,
+                 default_duration_ms: int = 1_000) -> None:
+        self.out_dir = str(out_dir)
+        self.min_interval_s = float(min_interval_s)
+        self.max_duration_ms = int(max_duration_ms)
+        self.default_duration_ms = int(default_duration_ms)
+        self._gate = threading.Lock()
+        self._last_end = -float("inf")  # monotonic; first capture always ok
+        self._n = 0
+
+    def capture(self, duration_ms: Optional[int] = None) -> dict[str, Any]:
+        """Open a capture window of ``duration_ms`` and return the
+        artifact doc. Raises :class:`ProfilerBusy` /
+        :class:`ProfilerRateLimited` / :class:`ProfilerError`."""
+        d_ms = int(duration_ms) if duration_ms else self.default_duration_ms
+        if d_ms < 1:
+            raise ProfilerError(f"duration_ms must be positive, got {d_ms}")
+        d_ms = min(d_ms, self.max_duration_ms)
+        if not self._gate.acquire(blocking=False):
+            raise ProfilerBusy("capture already in progress")
+        try:
+            wait = self._last_end + self.min_interval_s - time.monotonic()
+            if wait > 0:
+                raise ProfilerRateLimited(wait)
+            self._n += 1
+            cap_dir = os.path.join(self.out_dir, f"xplane_{self._n:03d}")
+            os.makedirs(cap_dir, exist_ok=True)
+            import jax
+
+            try:
+                jax.profiler.start_trace(cap_dir)
+            except Exception as e:  # noqa: BLE001
+                raise ProfilerError(
+                    f"start_trace failed: {type(e).__name__}: {e}"
+                ) from e
+            try:
+                # Marker op: guarantees at least one traced device event
+                # even when the process is idle for the whole window.
+                import jax.numpy as jnp
+
+                jnp.zeros((8, 8)).sum().block_until_ready()
+                time.sleep(d_ms / 1000.0)
+            finally:
+                try:
+                    jax.profiler.stop_trace()
+                finally:
+                    self._last_end = time.monotonic()
+            artifacts = sorted(
+                os.path.relpath(p, cap_dir)
+                for p in glob.glob(
+                    os.path.join(cap_dir, "**", "*"), recursive=True
+                )
+                if os.path.isfile(p)
+            )
+            total = sum(
+                os.path.getsize(os.path.join(cap_dir, a)) for a in artifacts
+            )
+            return {
+                "dir": cap_dir,
+                "duration_ms": d_ms,
+                "artifacts": artifacts,
+                "artifact_bytes": int(total),
+                "xplane_files": [a for a in artifacts
+                                 if a.endswith(".xplane.pb")],
+            }
+        finally:
+            self._gate.release()

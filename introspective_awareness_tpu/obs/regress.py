@@ -111,6 +111,20 @@ HEADLINES: tuple = (
 )
 
 
+# Informational (non-gating) device-utilization fields: surfaced in the
+# verdict doc so the trajectory of "how close to the roofline are we"
+# is visible round over round, but NEVER part of the pass/regress
+# decision — on the CPU smoke the peaks are calibration-free fallbacks,
+# and on TPU a utilization drop usually co-moves with a throughput
+# headline that already gates.
+INFORMATIONAL: tuple = (
+    ("hbm_bw_util_frac",
+     lambda doc: (doc.get("roofline") or {}).get("decode_hbm_bw_util_frac")),
+    ("flops_util_frac",
+     lambda doc: (doc.get("roofline") or {}).get("decode_flops_util_frac")),
+)
+
+
 def backend_of(doc: Optional[dict]) -> Optional[str]:
     """Best-effort backend name ("cpu" / "tpu" / "gpu") for a bench doc."""
     if not isinstance(doc, dict):
@@ -194,6 +208,24 @@ def compare(current: dict, history: list[tuple[Optional[dict], Any]],
             row["verdict"] = "pass"
         metrics.append(row)
 
+    # Informational utilization trajectory: current + newest comparable
+    # reference per field, history-tolerant (rounds predating the
+    # roofline plane just leave the reference null). Never gates.
+    informational: dict[str, Any] = {}
+    for name, extract in INFORMATIONAL:
+        cur = extract(current) if isinstance(current, dict) else None
+        ref = ref_lab = None
+        for doc, lab in reversed(comparable):
+            v = extract(doc)
+            if v is not None:
+                ref, ref_lab = float(v), lab
+                break
+        informational[name] = {
+            "current": cur,
+            "reference": ref,
+            "reference_round": ref_lab,
+        }
+
     compared = [m for m in metrics if m["verdict"] != "skipped"]
     if any(m["verdict"] == "regress" for m in compared):
         verdict = "regress"
@@ -211,6 +243,7 @@ def compare(current: dict, history: list[tuple[Optional[dict], Any]],
         "n_comparable": len(comparable),
         "crashed_rounds": skipped_rounds,
         "metrics": metrics,
+        "informational": informational,
     }
 
 
@@ -274,4 +307,14 @@ def format_report(result: dict[str, Any]) -> str:
                 f" (round {m['reference_round']},"
                 f" Δ={m['delta']:+.4f}, margin=±{m['margin']:.4f}, good {arrow})"
             )
+    info = result.get("informational") or {}
+    for name, row in info.items():
+        cur, ref = row.get("current"), row.get("reference")
+        lines.append(
+            f"  {name:<24} info    "
+            f" current={'-' if cur is None else format(cur, '.4f')}"
+            f" ref={'-' if ref is None else format(ref, '.4f')}"
+            + (f" (round {row['reference_round']})"
+               if row.get("reference_round") is not None else "")
+        )
     return "\n".join(lines)

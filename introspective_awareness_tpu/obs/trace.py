@@ -45,25 +45,35 @@ from typing import Any, Optional
 
 # Event tuple layout: (op, kind, seq, t0, t1).
 #   op:   "beg" | "disp" | "land" | "proc" | "stall" | "gsub" | "gret"
+#         | "tok"
 #   kind: dispatch kind ("chunk" | "refill" | "stage") or None
-#   seq:  per-run dispatch sequence number (grade events: trial index / n)
+#         ("tok" events: the request-scoped trace id)
+#   seq:  per-run dispatch sequence number (grade events: trial index / n;
+#         "tok" events: tokens landed for that request this chunk)
 _PERF = time.perf_counter
 
 
 class ChunkTrace:
     """Bounded ring buffer of scheduler/grading events + attribution."""
 
-    __slots__ = ("_ev", "capacity", "n_recorded")
+    __slots__ = ("_ev", "capacity", "n_recorded", "unix_anchor")
 
     def __init__(self, capacity: int = 65536) -> None:
         self.capacity = max(16, int(capacity))
         self._ev: deque = deque(maxlen=self.capacity)
         self.n_recorded = 0
+        # (perf_counter, unix) pair taken at the first begin(): maps the
+        # trace's monotonic timestamps onto wall-clock time, which is what
+        # lets the fabric/coordinator merge timelines recorded on
+        # different hosts onto one Perfetto axis.
+        self.unix_anchor: Optional[tuple[float, float]] = None
 
     # -- hot-path recording (one tuple append each) -------------------------
 
     def begin(self, t: Optional[float] = None) -> None:
         """Anchor the first interval at the loop start."""
+        if self.unix_anchor is None:
+            self.unix_anchor = (_PERF(), time.time())
         self.n_recorded += 1
         self._ev.append(("beg", None, 0, _PERF() if t is None else t, 0.0))
 
@@ -85,6 +95,14 @@ class ChunkTrace:
         """Staging ran with a dry pool while admission was demanded."""
         self.n_recorded += 1
         self._ev.append(("stall", None, 0, t0, t1))
+
+    def tokens(self, trace_id: str, n: int) -> None:
+        """Request-scoped token landing (serving plane): ``n`` tokens for
+        request ``trace_id`` arrived with this chunk's harvest. Lets the
+        exported timeline attribute decode chunks to the tenant requests
+        they served."""
+        self.n_recorded += 1
+        self._ev.append(("tok", trace_id, n, _PERF(), 0.0))
 
     def grade_submit(self, idx: int) -> None:
         self.n_recorded += 1
@@ -215,26 +233,42 @@ class ChunkTrace:
 
     # -- Chrome-trace / Perfetto export -------------------------------------
 
-    def to_perfetto(self) -> dict[str, Any]:
+    def to_perfetto(self, label: Optional[str] = None,
+                    pid_base: int = 1) -> dict[str, Any]:
         """Chrome-trace JSON (the ``traceEvents`` array format): open in
         https://ui.perfetto.dev or ``chrome://tracing``. Tracks: device
-        in-flight spans, host flag waits, admission stalls, grading."""
+        in-flight spans, host flag waits, admission stalls, grading.
+
+        ``label`` prefixes the process names (per-replica exports);
+        ``pid_base`` offsets the two pids so several traces can share one
+        timeline without colliding. A ``metadata.unix_base_s`` key maps
+        ``ts`` 0 onto wall-clock time when the trace was begun with an
+        anchor — :func:`merge_timelines` aligns on it."""
         ev = list(self._ev)
+        pfx = f"{label}/" if label else ""
+        pid_s, pid_g = int(pid_base), int(pid_base) + 1
         if not ev:
-            return {"traceEvents": [], "displayTimeUnit": "ms"}
+            return {"traceEvents": [], "displayTimeUnit": "ms",
+                    "metadata": {"unix_base_s": None, "label": label}}
         t_base = min(e[3] for e in ev)
+        unix_base = None
+        if self.unix_anchor is not None:
+            perf_a, unix_a = self.unix_anchor
+            unix_base = unix_a + (t_base - perf_a)
 
         def us(t: float) -> float:
             return round((t - t_base) * 1e6, 3)
 
         out: list[dict[str, Any]] = []
-        for pid, pname in ((1, "scheduler"), (2, "grading")):
+        for pid, pname in ((pid_s, f"{pfx}scheduler"),
+                           (pid_g, f"{pfx}grading")):
             out.append({"ph": "M", "name": "process_name", "pid": pid,
                         "tid": 0, "args": {"name": pname}})
         for pid, tid, tname in (
-            (1, 1, "device in-flight"), (1, 2, "host wait"),
-            (1, 3, "dispatch"), (1, 4, "admission stalls"),
-            (2, 1, "grade batches"), (2, 2, "submits"),
+            (pid_s, 1, "device in-flight"), (pid_s, 2, "host wait"),
+            (pid_s, 3, "dispatch"), (pid_s, 4, "admission stalls"),
+            (pid_s, 5, "request tokens"),
+            (pid_g, 1, "grade batches"), (pid_g, 2, "submits"),
         ):
             out.append({"ph": "M", "name": "thread_name", "pid": pid,
                         "tid": tid, "args": {"name": tname}})
@@ -244,35 +278,92 @@ class ChunkTrace:
             if op == "disp":
                 disp_t[(kind, seq)] = t0
                 out.append({"ph": "i", "name": f"dispatch {kind} #{seq}",
-                            "pid": 1, "tid": 3, "ts": us(t0), "s": "t"})
+                            "pid": pid_s, "tid": 3, "ts": us(t0), "s": "t"})
             elif op == "land":
                 out.append({"ph": "X", "name": f"wait {kind} #{seq}",
-                            "pid": 1, "tid": 2, "ts": us(t0),
+                            "pid": pid_s, "tid": 2, "ts": us(t0),
                             "dur": max(round((t1 - t0) * 1e6, 3), 0.001)})
             elif op == "proc":
                 td = disp_t.get((kind, seq), t0)
                 out.append({"ph": "X", "name": f"{kind} #{seq}",
-                            "pid": 1, "tid": 1, "ts": us(td),
+                            "pid": pid_s, "tid": 1, "ts": us(td),
                             "dur": max(round((t0 - td) * 1e6, 3), 0.001),
                             "args": {"kind": kind, "seq": int(seq)}})
             elif op == "stall":
                 out.append({"ph": "X", "name": "admission stall",
-                            "pid": 1, "tid": 4, "ts": us(t0),
+                            "pid": pid_s, "tid": 4, "ts": us(t0),
                             "dur": max(round((t1 - t0) * 1e6, 3), 0.001)})
+            elif op == "tok":
+                out.append({"ph": "i", "name": f"{kind} +{int(seq)} tok",
+                            "pid": pid_s, "tid": 5, "ts": us(t0), "s": "t",
+                            "args": {"trace_id": kind, "n": int(seq)}})
             elif op == "gsub":
                 out.append({"ph": "i", "name": f"submit trial {seq}",
-                            "pid": 2, "tid": 2, "ts": us(t0), "s": "t"})
+                            "pid": pid_g, "tid": 2, "ts": us(t0), "s": "t"})
             elif op == "gret":
                 out.append({"ph": "X", "name": f"grade batch [{seq}]",
-                            "pid": 2, "tid": 1, "ts": us(t0),
+                            "pid": pid_g, "tid": 1, "ts": us(t0),
                             "dur": max(round((t1 - t0) * 1e6, 3), 0.001),
                             "args": {"batch_size": int(seq)}})
-        return {"traceEvents": out, "displayTimeUnit": "ms"}
+        return {"traceEvents": out, "displayTimeUnit": "ms",
+                "metadata": {"unix_base_s": unix_base, "label": label}}
 
     def save_perfetto(self, path: str) -> str:
         with open(path, "w", encoding="utf-8") as f:
             json.dump(self.to_perfetto(), f)
         return path
+
+
+def merge_timelines(
+    docs: list[tuple[str, dict[str, Any]]],
+) -> dict[str, Any]:
+    """Merge several ``to_perfetto`` docs onto one timeline.
+
+    ``docs`` is ``[(label, doc), ...]`` — per-replica exports from the
+    sweep fabric or per-host exports pulled by the coordinator. Each
+    doc's events are shifted so that when EVERY doc carries a
+    ``metadata.unix_base_s`` anchor, their wall-clock alignment is
+    preserved (the earliest anchor becomes ``ts`` 0); anchorless docs
+    are left at their own zero. Pids are remapped to disjoint ranges and
+    process names get the label prefix, so Perfetto shows one process
+    group per replica/host."""
+    merged: list[dict[str, Any]] = []
+    bases = [
+        d.get("metadata", {}).get("unix_base_s")
+        for _, d in docs
+    ]
+    anchored = [b for b in bases if b is not None]
+    t0 = min(anchored) if anchored else None
+    pid_next = 1
+    for (label, doc), base in zip(docs, bases):
+        evs = doc.get("traceEvents", [])
+        shift_us = (
+            round((base - t0) * 1e6, 3)
+            if base is not None and t0 is not None else 0.0
+        )
+        pids = sorted({int(e.get("pid", 0)) for e in evs})
+        remap = {p: pid_next + i for i, p in enumerate(pids)}
+        pid_next += len(pids)
+        for e in evs:
+            e = dict(e)
+            e["pid"] = remap.get(int(e.get("pid", 0)), e.get("pid", 0))
+            if "ts" in e:
+                e["ts"] = round(e["ts"] + shift_us, 3)
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                args = dict(e.get("args", {}))
+                pname = str(args.get("name", ""))
+                if label and not pname.startswith(f"{label}/"):
+                    args["name"] = f"{label}/{pname}"
+                e["args"] = args
+            merged.append(e)
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "unix_base_s": t0,
+            "merged_from": [label for label, _ in docs],
+        },
+    }
 
 
 def format_attribution(summary: dict[str, Any]) -> str:

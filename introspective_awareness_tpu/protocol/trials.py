@@ -44,6 +44,7 @@ def run_trial_pass(
     stop_event=None,
     faults=None,
     trace=None,
+    roofline=None,
     fabric=None,
 ) -> list[dict]:
     """One batched pass of a trial type over (concept, trial) tasks.
@@ -81,7 +82,7 @@ def run_trial_pass(
             draft_layers=draft_layers, grade_pool=grade_pool,
             journal=journal, pass_key=pass_key,
             stop_event=stop_event, faults=faults, trace=trace,
-            fabric=fabric,
+            roofline=roofline, fabric=fabric,
         )
     if scheduler != "batch":
         raise ValueError(f"unknown scheduler {scheduler!r}")
@@ -155,6 +156,7 @@ def run_grid_pass(
     stop_event=None,
     faults=None,
     trace=None,
+    roofline=None,
     fabric=None,
 ) -> list[dict]:
     """One batched pass where every row may belong to a DIFFERENT
@@ -199,7 +201,11 @@ def run_grid_pass(
     ``faults`` threads the deterministic fault plan through. ``trace`` (a
     :class:`~introspective_awareness_tpu.obs.ChunkTrace`; continuous only)
     records per-chunk dispatch/land/harvest events for the flight-recorder
-    timeline and attribution.
+    timeline and attribution. ``roofline`` (a
+    :class:`~introspective_awareness_tpu.obs.RooflineMeter`; continuous
+    only) attaches the device-measurement plane — per-executable
+    FLOPs/HBM-byte costs and utilization gauges. Both are host-side
+    observers: attaching them never changes any decoded token.
 
     ``speculate_k``/``draft_layers`` (continuous only) run decode in
     self-speculative multi-token rounds (runtime.generate). Greedy trials
@@ -366,6 +372,7 @@ def run_grid_pass(
                     stop_event=stop_event,
                     faults=faults,
                     trace=trace,
+                    roofline=roofline,
                     **fab_extra,
                 )
             except SweepInterrupted:

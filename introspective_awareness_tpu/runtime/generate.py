@@ -1474,3 +1474,21 @@ def scheduler_stage_paged(
         tail0 = jnp.zeros((R, 0), jnp.int32)
     true_sfx = amask.sum(axis=1).astype(jnp.int32)
     return sk, sv, smask, spos, tok0, done0, true_sfx, keydata, tail0
+
+
+# Stable executable names for the device-measurement plane: the cost
+# index (obs/cost.py) keys per-dispatch FLOPs/HBM-bytes by these, and
+# the scheduler threads them to its roofline meter at dispatch time.
+# Names are part of the observability contract (bench sections,
+# run_manifest roofline blocks) — add entries, don't rename them.
+EXECUTABLES = {
+    "generate_tokens": generate_tokens,
+    "generate_tokens_prefix": generate_tokens_prefix,
+    "scheduler_init": scheduler_init,
+    "scheduler_refill": scheduler_refill,
+    "scheduler_stage": scheduler_stage,
+    "scheduler_stage_paged": scheduler_stage_paged,
+    "scheduler_admit": scheduler_admit,
+    "scheduler_decode_chunk": scheduler_decode_chunk,
+    "scheduler_decode_chunk_speculate": scheduler_decode_chunk_speculate,
+}

@@ -247,7 +247,16 @@ def paged_decode_chunk_speculate(
     return dpk, dpv, mpos, mvalid, state, tokens, flags
 
 
+# Stable executable names for the device-measurement plane (see
+# runtime.generate.EXECUTABLES for the contract).
+PAGED_EXECUTABLES = {
+    "paged_admit": paged_admit,
+    "paged_decode_chunk": paged_decode_chunk,
+    "paged_decode_chunk_speculate": paged_decode_chunk_speculate,
+}
+
 __all__ = [
+    "PAGED_EXECUTABLES",
     "paged_admit",
     "paged_decode_chunk",
     "paged_decode_chunk_speculate",

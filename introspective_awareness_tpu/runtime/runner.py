@@ -16,6 +16,7 @@ handful of executables regardless of ragged trial counts (SURVEY.md §7.4.2).
 from __future__ import annotations
 
 import gc
+import time
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -123,6 +124,13 @@ class ModelRunner:
         self.kv_pool_pages = kv_pool_pages
         self.last_autotune: Optional[dict] = None
         self._aot_cache: dict = {}
+        # Device-measurement plane, batch path: a RooflineMeter attached
+        # here (late-bound, opt-in — pays one AOT compile per executable)
+        # cost-indexes the fixed-batch generate executables too, so the
+        # on-device judge's decodes show up in the roofline block. The
+        # prefix distinguishes subject vs judge rows ("judge_generate_...").
+        self.roofline = None
+        self.roofline_prefix = ""
         # Sequence parallelism: with a seq mesh axis > 1, S>1 chunks attend
         # via ring attention (ops/ring.py) and the shared-prefix split is
         # disabled (its suffix pass runs the cached-attention branch, which
@@ -665,6 +673,14 @@ class ModelRunner:
             fn_kwargs = {
                 "max_new_tokens": max_new_tokens, "sp_mesh": self.sp_mesh,
             }
+        meter, t_disp = self.roofline, 0.0
+        if meter is not None:
+            ex_name = self.roofline_prefix + (
+                "generate_tokens_prefix" if L0 else "generate_tokens"
+            )
+            meter.capture_once(ex_name, fn, *fn_args, **fn_kwargs)
+            meter.dispatched(ex_name, "batch")
+            t_disp = time.perf_counter()
         with self.ledger.span(
             "generate", batch=B, batch_padded=int(Bp), seq=int(S),
             prefix_len=int(L0), max_new_tokens=int(max_new_tokens),
@@ -685,6 +701,10 @@ class ModelRunner:
                 tokens = fn(*fn_args, **fn_kwargs)
             sp.watch(tokens)
             tokens = np.asarray(tokens)
+            if meter is not None:
+                # Batch calls are synchronous end to end: the dispatch-to-
+                # landing wall clock is the device-time estimate.
+                meter.processed("batch", time.perf_counter() - t_disp)
             # Honest decode throughput: count real generated tokens (stop at
             # EOS/pad) over the B live rows, not Bp x max_new upper bound.
             eos = np.array(
@@ -859,6 +879,7 @@ class ModelRunner:
         stop_event=None,
         faults=None,
         trace=None,
+        roofline=None,
         speculate_k: int = 0,
         draft_layers: Optional[int] = None,
         **kw,
@@ -901,8 +922,10 @@ class ModelRunner:
         :class:`~introspective_awareness_tpu.runtime.faults.FaultPlan`
         whose crash points fire between harvested chunks. ``trace`` (an
         ``obs.trace.ChunkTrace``) attaches the per-chunk flight recorder
-        to the scheduler loop; the fixed-batch fallback has no chunk
-        boundaries to record and ignores it.
+        to the scheduler loop; ``roofline`` (an
+        ``obs.roofline.RooflineMeter``) attaches the device-measurement
+        plane the same way. The fixed-batch fallback has no chunk
+        boundaries to record and ignores both.
 
         Eligibility: no sequence-parallel mesh and an active merged decode
         tier. Within that, queues with a broadcastable shared prefix run
@@ -1013,7 +1036,7 @@ class ModelRunner:
                 refill_frac=refill_frac, pipeline=pipeline,
                 suffix_bucket=suffix_bucket, result_cb=result_cb,
                 trial_ids=trial_ids, stop_event=stop_event, faults=faults,
-                trace=trace, speculate_k=speculate_k,
+                trace=trace, roofline=roofline, speculate_k=speculate_k,
                 draft_layers=int(draft_layers) if speculate_k else 0,
             )
         if L0 == 0:
@@ -1137,7 +1160,7 @@ class ModelRunner:
                 pipeline=pipeline, staged=staged, lookahead=lookahead,
                 suffix_bucket=suffix_bucket, result_cb=tok_cb,
                 trial_ids=trial_ids, stop_event=stop_event, faults=faults,
-                trace=trace,
+                trace=trace, roofline=roofline,
                 replica=str(getattr(self, "replica_label", "0")),
                 speculate_k=speculate_k,
                 draft_layers=int(draft_layers) if speculate_k else 0,
@@ -1179,6 +1202,7 @@ class ModelRunner:
         trace,
         speculate_k: int,
         draft_layers: int,
+        roofline=None,
     ) -> list[str]:
         """Paged-KV scheduled generation (``run_scheduled_paged``): full
         unpadded prompts queue directly — prefix sharing is per-trial radix
@@ -1239,7 +1263,7 @@ class ModelRunner:
                 ledger=self.ledger, pipeline=pipeline,
                 suffix_bucket=suffix_bucket, result_cb=tok_cb,
                 trial_ids=trial_ids, stop_event=stop_event, faults=faults,
-                trace=trace,
+                trace=trace, roofline=roofline,
                 replica=str(getattr(self, "replica_label", "0")),
                 speculate_k=speculate_k, draft_layers=draft_layers,
             )

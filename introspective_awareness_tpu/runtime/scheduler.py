@@ -251,6 +251,7 @@ def run_scheduled(
     replica: str = "0",
     speculate_k: int = 0,
     draft_layers: int = 0,
+    roofline=None,
 ) -> tuple[list[np.ndarray], dict]:
     """Drain ``trials`` through ``slots`` decode rows; returns per-trial
     token arrays (input order, length = tokens actually emitted, final
@@ -304,6 +305,13 @@ def run_scheduled(
     ``replica`` labels this run's live-metrics series in the registry so
     concurrent sweep-fabric replicas stay distinguishable; single-replica
     runs land in the default ``replica="0"`` series.
+
+    ``roofline`` (an ``obs.roofline.RooflineMeter``) attaches the
+    device-measurement plane: each executable's compile-time
+    FLOPs/HBM-bytes are captured once at its first dispatch (one extra
+    AOT compile per executable — which is why this is opt-in) and every
+    dispatch/harvest feeds the meter's utilization windows. Purely
+    host-side: outputs are bit-identical with or without it.
 
     ``speculate_k > 0`` switches decode chunks to self-speculative
     multi-token rounds (``scheduler_decode_chunk_speculate``): the first
@@ -362,16 +370,32 @@ def run_scheduled(
         stop = jnp.asarray(np.asarray(stop_seqs, np.int32))
     stop_width = int(stop.shape[1]) if stop is not None else 0
 
+    prefix_j = jnp.asarray(np.asarray(prefix_ids, np.int32))
     if staged:
+        if roofline is not None:
+            roofline.capture_once(
+                "scheduler_init", scheduler_init, params, cfg, prefix_j,
+                slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
+                stop_width=stop_width, with_prefix=True,
+                speculate_k=speculate_k,
+            )
+            roofline.dispatched("scheduler_init", "init")
         cache, state, pk, pv = scheduler_init(
-            params, cfg, jnp.asarray(np.asarray(prefix_ids, np.int32)),
+            params, cfg, prefix_j,
             slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
             stop_width=stop_width, with_prefix=True,
             speculate_k=speculate_k,
         )
     else:
+        if roofline is not None:
+            roofline.capture_once(
+                "scheduler_init", scheduler_init, params, cfg, prefix_j,
+                slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
+                stop_width=stop_width, speculate_k=speculate_k,
+            )
+            roofline.dispatched("scheduler_init", "init")
         cache, state = scheduler_init(
-            params, cfg, jnp.asarray(np.asarray(prefix_ids, np.int32)),
+            params, cfg, prefix_j,
             slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
             stop_width=stop_width, speculate_k=speculate_k,
         )
@@ -512,6 +536,16 @@ def run_scheduled(
             kd_buf[s] = trial_keydata[next_trial + j]
             slot_trial[s] = next_trial + j
             rem[s] = t.budget - 1
+        if roofline is not None:
+            # Lowering traces avals only — safe before the donating call.
+            roofline.capture_once(
+                "scheduler_refill", scheduler_refill,
+                params, cfg, cache, state, spec,
+                jnp.array(sfx_buf), jnp.array(msk_buf), jnp.array(rm_buf),
+                jnp.array(lay_buf), jnp.array(stg_buf), jnp.array(vec_buf),
+                jnp.array(sta_buf), jnp.array(bud_buf), jnp.array(kd_buf),
+            )
+            roofline.dispatched("scheduler_refill", "refill")
         cache, state, tok0, flags = scheduler_refill(
             params, cfg, cache, state, spec,
             jnp.array(sfx_buf), jnp.array(msk_buf), jnp.array(rm_buf),
@@ -574,6 +608,14 @@ def run_scheduled(
             kd[j] = trial_keydata[next_stage + j]
         budj, layj = jnp.asarray(bud), jnp.asarray(lay)
         stgj, vecj = jnp.asarray(stg), jnp.asarray(vec)
+        if roofline is not None:
+            roofline.capture_once(
+                "scheduler_stage", scheduler_stage,
+                params, cfg, pk, pv, spec, jnp.asarray(sfx),
+                jnp.asarray(msk), layj, stgj, vecj, jnp.asarray(sta),
+                budj, jnp.asarray(kd),
+            )
+            roofline.dispatched("scheduler_stage", "stage")
         sk, sv, smask, spos, tok0, done0, true_sfx, keydata, tail0 = (
             scheduler_stage(
                 params, cfg, pk, pv, spec, jnp.asarray(sfx),
@@ -622,6 +664,16 @@ def run_scheduled(
                 slot_map[grp.cursor + j] = s
                 slot_trial[s] = qi
                 rem[s] = trials[qi].budget - 1
+            if roofline is not None:
+                roofline.capture_once(
+                    "scheduler_admit", scheduler_admit,
+                    cfg, cache, state, spec, jnp.asarray(slot_map),
+                    grp.sk, grp.sv, grp.smask, grp.spos, grp.tok0,
+                    grp.done0, grp.true_sfx, grp.budget, grp.layer,
+                    grp.strength, grp.vectors, grp.keydata, grp.tail,
+                    suffix_len=Ss,
+                )
+                roofline.dispatched("scheduler_admit", "refill")
             cache, state, tok0, flags = scheduler_admit(
                 cfg, cache, state, spec, jnp.asarray(slot_map),
                 grp.sk, grp.sv, grp.smask, grp.spos, grp.tok0, grp.done0,
@@ -648,11 +700,26 @@ def run_scheduled(
         nonlocal cache, state, g, d_seq
         page = jnp.int32(g % n_chunks) if n_chunks else jnp.int32(0)
         if speculate_k:
+            if roofline is not None:
+                roofline.capture_once(
+                    "scheduler_decode_chunk_speculate",
+                    scheduler_decode_chunk_speculate,
+                    params, cfg, cache, state, spec, page,
+                    rounds=rounds, k=speculate_k, draft_layers=draft_layers,
+                )
+                roofline.dispatched(
+                    "scheduler_decode_chunk_speculate", "chunk")
             cache, state, toks, flags = scheduler_decode_chunk_speculate(
                 params, cfg, cache, state, spec, page,
                 rounds=rounds, k=speculate_k, draft_layers=draft_layers,
             )
         else:
+            if roofline is not None:
+                roofline.capture_once(
+                    "scheduler_decode_chunk", scheduler_decode_chunk,
+                    params, cfg, cache, state, spec, page, ch=ch,
+                )
+                roofline.dispatched("scheduler_decode_chunk", "chunk")
             cache, state, toks, flags = scheduler_decode_chunk(
                 params, cfg, cache, state, spec, page, ch=ch
             )
@@ -745,6 +812,8 @@ def run_scheduled(
         m_depth.set(len(pending), **_rl)
         if trace is not None:
             trace.processed(ev.kind, ev.seq)
+        if roofline is not None:
+            roofline.processed(ev.kind, wait_s)
         if not pending:
             gauges.idle_start()
         if faults is not None and ev.kind == "chunk":
@@ -917,6 +986,7 @@ def run_scheduled_paged(
     feed: Optional[SchedulerFeed] = None,
     token_cb: Optional[Callable[[int, np.ndarray], None]] = None,
     max_prompt_len: Optional[int] = None,
+    roofline=None,
 ) -> tuple[list[np.ndarray], dict]:
     """``run_scheduled`` over the PAGED KV cache (``runtime.paged``).
 
@@ -1310,6 +1380,14 @@ def run_scheduled_paged(
             ).astype(np.int32)
         budj, layj = jnp.asarray(bud), jnp.asarray(lay)
         stgj, vecj = jnp.asarray(stg), jnp.asarray(vec)
+        if roofline is not None:
+            roofline.capture_once(
+                "scheduler_stage_paged", scheduler_stage_paged,
+                params, cfg, ppk, ppv, spec, jnp.asarray(ptab_s),
+                jnp.asarray(plen_s), jnp.asarray(sfx), jnp.asarray(msk),
+                layj, stgj, vecj, jnp.asarray(sta), budj, jnp.asarray(kd),
+            )
+            roofline.dispatched("scheduler_stage_paged", "stage")
         (sk, sv, smask, spos, tok0, done0, true_sfx, keydata, tail0) = (
             scheduler_stage_paged(
                 params, cfg, ppk, ppv, spec, jnp.asarray(ptab_s),
@@ -1334,6 +1412,15 @@ def run_scheduled_paged(
             slot_pages[s] = all_pages
             ptab_h[s] = Pp
             ptab_h[s, :len(all_pages)] = all_pages
+        if roofline is not None:
+            roofline.capture_once(
+                "paged_admit", paged_admit,
+                ppk, ppv, state, spec, jnp.asarray(slot_map),
+                jnp.asarray(dest), sk, sv, tok0, done0,
+                jnp.asarray(true_ctx), budj, layj, stgj, vecj, keydata,
+                tail0, mvalid,
+            )
+            roofline.dispatched("paged_admit", "refill")
         ppk, ppv, mvalid, state, tok0_b, flags = paged_admit(
             ppk, ppv, state, spec, jnp.asarray(slot_map),
             jnp.asarray(dest), sk, sv, tok0, done0,
@@ -1368,6 +1455,15 @@ def run_scheduled_paged(
         nonlocal dpk, dpv, mpos, mvalid, state, g, d_seq
         ptab_j = jnp.asarray(ptab_h)
         if speculate_k:
+            if roofline is not None:
+                roofline.capture_once(
+                    "paged_decode_chunk_speculate",
+                    paged_decode_chunk_speculate,
+                    params, cfg, ppk, ppv, dpk, dpv, mpos, mvalid, state,
+                    spec, ptab_j, dtab_j,
+                    rounds=rounds, k=speculate_k, draft_layers=draft_layers,
+                )
+                roofline.dispatched("paged_decode_chunk_speculate", "chunk")
             dpk, dpv, mpos, mvalid, state, toks, flags = (
                 paged_decode_chunk_speculate(
                     params, cfg, ppk, ppv, dpk, dpv, mpos, mvalid, state,
@@ -1377,6 +1473,13 @@ def run_scheduled_paged(
             )
         else:
             page = jnp.int32(g % PS) if PS else jnp.int32(0)
+            if roofline is not None:
+                roofline.capture_once(
+                    "paged_decode_chunk", paged_decode_chunk,
+                    params, cfg, ppk, ppv, dpk, dpv, mpos, mvalid, state,
+                    spec, ptab_j, dtab_j, page, ch=ring_w,
+                )
+                roofline.dispatched("paged_decode_chunk", "chunk")
             dpk, dpv, mpos, mvalid, state, toks, flags = paged_decode_chunk(
                 params, cfg, ppk, ppv, dpk, dpv, mpos, mvalid, state, spec,
                 ptab_j, dtab_j, page, ch=ring_w,
@@ -1499,6 +1602,8 @@ def run_scheduled_paged(
         m_depth.set(len(pending), **_rl)
         if trace is not None:
             trace.processed(ev.kind, ev.seq)
+        if roofline is not None:
+            roofline.processed(ev.kind, wait_s)
         if not pending:
             gauges.idle_start()
         if faults is not None and ev.kind == "chunk":

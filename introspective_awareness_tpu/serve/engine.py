@@ -32,6 +32,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Any, Optional
 
@@ -71,6 +72,13 @@ class ResponseStream:
         self.req = req
         self.trial = trial
         self.stream_id = int(stream_id)
+        # Request-scoped trace id: derived from the rid alone so a
+        # crash-recovered request recomputes the SAME id (the journaled
+        # spec round-trips through SteerRequest.from_spec, which rejects
+        # unknown keys — the id must never ride in the spec).
+        self.trace_id = (
+            f"r{zlib.crc32(req.rid.encode('utf-8')) & 0xFFFFFFFF:08x}"
+        )
         self.q: "queue.Queue[dict]" = queue.Queue()
         self.t_enqueue = time.monotonic()
         self.t_first: Optional[float] = None
@@ -95,6 +103,8 @@ class ServeEngine(SchedulerFeed):
         journal=None,
         registry: Optional[MetricsRegistry] = None,
         replica: str = "serve",
+        trace=None,
+        roofline=None,
     ) -> None:
         self.runner = runner
         self.slots = int(slots)
@@ -105,6 +115,12 @@ class ServeEngine(SchedulerFeed):
         self.preempt_after_s = float(preempt_after_s)
         self.journal = journal
         self.replica = str(replica)
+        # Optional flight recorder + roofline meter for the serving loop:
+        # host-side observers only, so attaching them never changes what
+        # any tenant decodes. Request-scoped trace ids tie the recorded
+        # chunks back to the requests they served.
+        self.trace = trace
+        self.roofline = roofline
         self.tenants = tenants if tenants is not None else TenantTable(
             registry=registry)
         self.vectors = vectors if vectors is not None else VectorStore(
@@ -308,6 +324,8 @@ class ServeEngine(SchedulerFeed):
             self._h_itl.observe((now - st.t_last) / n, priority=pr)
         st.t_last = now
         st.n_tokens += n
+        if self.trace is not None and n:
+            self.trace.tokens(st.trace_id, n)
         if pr == "interactive":
             text = self._delta_text(toks)
             if text:
@@ -329,12 +347,14 @@ class ServeEngine(SchedulerFeed):
             self.journal.record_request_done(st.req.rid, {
                 "n_tokens": int(np.asarray(toks).shape[0]),
                 "preemptions": int(st.preemptions),
+                "trace_id": st.trace_id,
             })
         st.q.put({
             "done": True, "rid": st.req.rid, "text": text,
             "n_tokens": int(np.asarray(toks).shape[0]),
             "preemptions": int(st.preemptions),
             "stream": st.stream_id,
+            "trace_id": st.trace_id,
         })
 
     # -- lifecycle ----------------------------------------------------------
@@ -362,6 +382,8 @@ class ServeEngine(SchedulerFeed):
                     token_cb=self._on_tokens,
                     max_prompt_len=self.max_prompt_len,
                     replica=self.replica,
+                    trace=self.trace,
+                    roofline=self.roofline,
                 )
             except BaseException as e:  # noqa: BLE001 — surfaced at close()
                 self._loop_error = e

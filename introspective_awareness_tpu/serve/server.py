@@ -53,11 +53,15 @@ class ServeServer:
         registry: Optional[MetricsRegistry] = None,
         progress: Optional[ProgressTracker] = None,
         health: Optional[HealthState] = None,
+        profiler: Optional[Any] = None,
+        trace_source: Optional[Any] = None,
     ) -> None:
         self.engine = engine
         self.registry = registry if registry is not None else default_registry()
         self.progress = progress
         self.health = health if health is not None else HealthState()
+        self.profiler = profiler
+        self.trace_source = trace_source
         self._host = host
         self._want_port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -76,6 +80,7 @@ class ServeServer:
     def start(self) -> "ServeServer":
         engine, registry = self.engine, self.registry
         progress, health = self.progress, self.health
+        profiler, trace_source = self.profiler, self.trace_source
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"  # required for chunked responses
@@ -89,9 +94,13 @@ class ServeServer:
                 self.wfile.flush()
 
             def do_GET(self) -> None:
-                path = self.path.split("?", 1)[0]
+                parts = self.path.split("?", 1)
+                path = parts[0]
+                query = parts[1] if len(parts) > 1 else ""
                 if not handle_observability_get(
-                    self, path, registry, progress, health
+                    self, path, registry, progress, health,
+                    profiler=profiler, trace_source=trace_source,
+                    query=query,
                 ):
                     send_http(self, 404, "text/plain", b"not found\n")
 

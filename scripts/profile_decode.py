@@ -23,6 +23,49 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
+def _capture_xplane(args, run) -> None:
+    """Drive an on-demand XPlane capture through the /profile endpoint.
+
+    Exercises the exact path a live sweep or serve process exposes: a
+    :class:`~introspective_awareness_tpu.obs.ProfilerPlane` behind
+    ``GET /profile?duration_ms=``. With ``--profile-url`` the request goes
+    to that already-running metrics server (profiling a live process);
+    otherwise a throwaway local :class:`MetricsServer` is started and the
+    steady workload runs in a background thread so the capture window
+    actually sees device work. Prints the artifact manifest (capture dir,
+    xplane files, byte sizes) the endpoint returns.
+    """
+    import threading
+    import urllib.request
+
+    from introspective_awareness_tpu.obs import MetricsServer, ProfilerPlane
+
+    url, server, worker = args.profile_url, None, None
+    if url is None:
+        out_dir = os.path.join(args.trace_dir, "xplane")
+        server = MetricsServer(
+            profiler=ProfilerPlane(
+                out_dir, min_interval_s=0.0,
+                max_duration_ms=max(10_000, args.profile_duration_ms)),
+        ).start()
+        url = server.url
+        worker = threading.Thread(target=run, args=(2,), daemon=True)
+        worker.start()
+    try:
+        with urllib.request.urlopen(
+            f"{url}/profile?duration_ms={args.profile_duration_ms}",
+            timeout=args.profile_duration_ms / 1000.0 + 60.0,
+        ) as resp:
+            doc = json.loads(resp.read().decode("utf-8"))
+    finally:
+        if worker is not None:
+            worker.join()
+        if server is not None:
+            server.stop()
+    print("\n== xplane capture ==")
+    print(json.dumps(doc, indent=2))
+
+
 def _stage_breakdown(runner, cfg, tok, args, ledger) -> None:
     """A/B the slot scheduler's admission mechanisms and print the gauges.
 
@@ -124,6 +167,18 @@ def main() -> None:
                          "churny mixed-budget queue and print where the "
                          "admission time goes (host wait, device idle, "
                          "admit stall, stage/decode overlap)")
+    ap.add_argument("--capture-xplane", action="store_true",
+                    help="instead of the Chrome-trace parse, capture an "
+                         "XPlane profile of the steady run through the "
+                         "ProfilerPlane /profile endpoint (the same object "
+                         "a live sweep or serve process exposes) and print "
+                         "the artifact manifest")
+    ap.add_argument("--profile-url", default=None,
+                    help="with --capture-xplane: hit this live metrics "
+                         "server's /profile instead of spinning up a local "
+                         "one (e.g. http://127.0.0.1:9100)")
+    ap.add_argument("--profile-duration-ms", type=int, default=1000,
+                    help="with --capture-xplane: capture window in ms")
     args = ap.parse_args()
 
     import jax
@@ -188,6 +243,11 @@ def main() -> None:
     t0 = time.perf_counter()
     run(0)
     print(f"warmup {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    if args.capture_xplane:
+        _capture_xplane(args, run)
+        ledger.close()
+        return
     t0 = time.perf_counter()
     with ledger.span("generate", batch=args.batch,
                      max_new_tokens=args.max_new, steady_state=True) as sp:

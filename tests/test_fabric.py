@@ -274,6 +274,69 @@ def test_stolen_trials_keep_queue_indexed_streams(grid, make_runner):
     assert fab.last_stats["stolen_trials"] >= 1
 
 
+def test_fabric_per_replica_traces_and_merged_timeline(grid, make_runner):
+    """Every replica records into its own ChunkTrace (the caller's trace
+    becomes replica 0's); the merged Perfetto export labels each replica's
+    process group and keeps pid ranges disjoint. Attaching the observers
+    changes no output byte."""
+    from introspective_awareness_tpu.obs import ChunkTrace
+    from introspective_awareness_tpu.protocol.trials import run_grid_pass
+
+    runner, tasks, lookup = grid
+    ref = run_grid_pass(runner, "injection", tasks, lookup, **_kw(0.0))
+
+    fab = SweepFabric(
+        [make_runner(), make_runner()], registry=MetricsRegistry()
+    )
+    tr = ChunkTrace()
+    out = run_grid_pass(
+        runner, "injection", tasks, lookup, fabric=fab, trace=tr,
+        **_kw(0.0)
+    )
+    assert out == ref
+    assert len(fab.replica_traces) == 2
+    assert fab.replica_traces[0] is tr  # caller's trace = replica 0's
+    for t in fab.replica_traces:
+        assert len(t) > 0  # every replica recorded events
+
+    merged = fab.merged_timeline()
+    assert merged["metadata"]["merged_from"] == ["replica0", "replica1"]
+    by_rep: dict[str, set] = {}
+    for e in merged["traceEvents"]:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            rep = e["args"]["name"].split("/")[0]
+            by_rep.setdefault(rep, set()).add(e["pid"])
+    assert set(by_rep) == {"replica0", "replica1"}
+    assert not (by_rep["replica0"] & by_rep["replica1"])
+
+
+def test_fabric_roofline_meters_replica_zero_only(grid, make_runner):
+    """A RooflineMeter is single-writer: the fabric attaches it to replica
+    0 only, and its block still reports that replica's executables."""
+    from introspective_awareness_tpu.obs import ChunkTrace, RooflineMeter
+    from introspective_awareness_tpu.obs.registry import (
+        MetricsRegistry as Reg,
+    )
+    from introspective_awareness_tpu.protocol.trials import run_grid_pass
+
+    runner, tasks, lookup = grid
+    ref = run_grid_pass(runner, "injection", tasks, lookup, **_kw(0.0))
+
+    fab = SweepFabric(
+        [make_runner(), make_runner()], registry=MetricsRegistry()
+    )
+    tr = ChunkTrace()
+    meter = RooflineMeter(registry=Reg())
+    out = run_grid_pass(
+        runner, "injection", tasks, lookup, fabric=fab, trace=tr,
+        roofline=meter, **_kw(0.0)
+    )
+    assert out == ref
+    doc = meter.block(trace=fab.replica_traces[0])
+    assert doc["executables"], "replica 0 recorded no dispatches"
+    assert all(r["dispatches"] >= 1 for r in doc["executables"])
+
+
 def test_fabric_requires_explicit_seed(make_runner):
     fab = SweepFabric([make_runner()], registry=MetricsRegistry())
     with pytest.raises(ValueError, match="seed"):

@@ -110,6 +110,44 @@ class TestProgressTracker:
         assert s["evals_per_s"] > 0
         assert s["eta_s"] is not None
 
+    def test_eta_null_at_zero_done(self):
+        """Zero completed trials must read as a NULL ETA and zero rate —
+        never an extrapolation from a zero-trial rate (the /progress
+        divide-by-zero regression)."""
+        p = ProgressTracker()
+        p.set_total(100)
+        s = p.snapshot()
+        assert s["trials_done"] == 0
+        assert s["evals_per_s"] == 0.0
+        assert s["eta_s"] is None
+
+    def test_eta_null_at_zero_done_over_http(self):
+        from introspective_awareness_tpu.obs.registry import MetricsRegistry
+
+        p = ProgressTracker()
+        p.set_total(7)
+        srv = MetricsServer(registry=MetricsRegistry(), progress=p).start()
+        try:
+            with urllib.request.urlopen(
+                f"{srv.url}/progress", timeout=10
+            ) as r:
+                doc = json.loads(r.read().decode())
+        finally:
+            srv.stop()
+        assert doc["trials_total"] == 7 and doc["trials_done"] == 0
+        assert doc["eta_s"] is None  # JSON null, not NaN/Infinity
+        assert doc["evals_per_s"] == 0.0
+
+    def test_eta_appears_once_work_completes(self):
+        p = ProgressTracker()
+        p.set_total(4)
+        p.add_done(2)
+        s = p.snapshot()
+        assert s["eta_s"] is not None and s["eta_s"] >= 0
+        # done == total: nothing left, ETA back to null
+        p.add_done(2)
+        assert p.snapshot()["eta_s"] is None
+
 
 class TestMetricsServer:
     def test_endpoints_over_ephemeral_port(self):

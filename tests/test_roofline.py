@@ -1,0 +1,502 @@
+"""Device-measurement plane: cost index, roofline join, profiler gate.
+
+Synthetic-executable tests pin the arithmetic exactly (known FLOPs/bytes
+and hand-fed timestamps -> known utilization fractions); the CPU smoke
+runs the real scheduler with a meter attached and asserts the roofline
+block shows up, its rows sum sanely, and attaching the meter never
+changes a decoded token. The profiler tests cover the one-at-a-time /
+rate-limit gate and the ``/profile`` endpoint end to end."""
+
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from introspective_awareness_tpu.models import (
+    ByteTokenizer,
+    init_params,
+    tiny_config,
+)
+from introspective_awareness_tpu.obs import (
+    ChunkTrace,
+    ExecutableCostIndex,
+    MetricsServer,
+    ProfilerBusy,
+    ProfilerError,
+    ProfilerPlane,
+    ProfilerRateLimited,
+    RooflineMeter,
+    device_peaks,
+    merge_timelines,
+)
+from introspective_awareness_tpu.obs.registry import MetricsRegistry
+from introspective_awareness_tpu.runtime import ModelRunner
+
+SYNTH_PEAKS = {
+    "peak_flops": 200e9,
+    "peak_hbm_bw": 100e9,
+    "peak_source": "test",
+    "device_kind": "synthetic",
+}
+
+
+def _meter(**kw):
+    kw.setdefault("index", ExecutableCostIndex())
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("peaks", dict(SYNTH_PEAKS))
+    return RooflineMeter(**kw)
+
+
+# -- cost index ------------------------------------------------------------
+
+
+def test_cost_index_record_and_lookup():
+    idx = ExecutableCostIndex()
+    idx.record("e", flops=7.0, hbm_bytes=3.0, output_bytes=1.0)
+    assert "e" in idx and len(idx) == 1
+    entry = idx.get("e")
+    assert entry["flops"] == 7.0
+    assert entry["hbm_bytes"] == 3.0
+    assert entry["cost_available"] is True
+    assert entry["source"] == "synthetic"
+    assert idx.names() == ["e"]
+    snap = idx.snapshot()
+    assert snap["entries"]["e"]["flops"] == 7.0
+
+
+def test_cost_index_capture_real_executable():
+    """AOT capture of a real jitted call: idempotent, never raises, and on
+    CPU the XLA cost model reports nonzero FLOPs for a matmul."""
+    idx = ExecutableCostIndex()
+    f = jax.jit(lambda a, b: a @ b)
+    x = jax.numpy.ones((16, 16), jax.numpy.float32)
+    entry = idx.capture("mm", f, x, x)
+    assert entry["name"] == "mm"
+    assert entry["source"] in ("compiled", "error")
+    again = idx.capture("mm", f, x, x)
+    assert again is idx.get("mm")  # second capture is a cache hit
+    if entry["cost_available"]:
+        assert entry["flops"] > 0
+        assert entry["hbm_bytes"] > 0
+
+
+def test_capture_failure_degrades_to_zeros():
+    idx = ExecutableCostIndex()
+    entry = idx.capture("bad", object())  # no .lower(): capture must absorb
+    assert entry["source"] == "error"
+    assert entry["cost_available"] is False
+    assert entry["flops"] == 0.0
+    assert "bad" in idx  # still indexed: join degrades, never crashes
+
+
+# -- peaks -----------------------------------------------------------------
+
+
+def test_device_peaks_cpu_fallback():
+    p = device_peaks()
+    assert p["peak_source"] in (
+        "cpu_fallback", "unknown_fallback", "calibrated")
+    assert p["peak_flops"] > 0 and p["peak_hbm_bw"] > 0
+    if jax.devices()[0].platform == "cpu":
+        assert p["peak_source"] == "cpu_fallback"
+
+
+def test_device_peaks_calibrated_match():
+    dev = types.SimpleNamespace(device_kind="TPU v5e", platform="tpu")
+    p = device_peaks(dev)
+    assert p["peak_source"] == "calibrated"
+    assert p["peak_flops"] == 197e12
+    assert p["peak_hbm_bw"] == 819e9
+    assert p["device_kind"] == "TPU v5e"
+
+
+# -- exact utilization arithmetic ------------------------------------------
+
+
+def test_synthetic_exact_fractions():
+    """2 dispatches x (100 GFLOP, 50 GB) over 2.0s of hand-fed device time
+    against a (200 GFLOP/s, 100 GB/s) peak => exactly 0.5 / 0.5."""
+    m = _meter()
+    m.index.record("exec_a", flops=100e9, hbm_bytes=50e9, output_bytes=1e3)
+    for _ in range(2):
+        m.dispatched("exec_a", "chunk")
+    m.processed("chunk", 0.0, now=10.0)  # anchors the interval chain
+    m.processed("chunk", 0.0, now=11.0)
+    m.processed("chunk", 0.0, now=12.0)
+    doc = m.block()
+    assert doc["time_source"] == "meter_window"
+    assert doc["peak_source"] == "test"
+    assert doc["ridge_flops_per_byte"] == 2.0
+    assert doc["attributed_device_s"] == pytest.approx(2.0)
+    (row,) = doc["executables"]
+    assert row["name"] == "exec_a" and row["phase"] == "decode"
+    assert row["dispatches"] == 2
+    assert row["total_flops"] == pytest.approx(200e9)
+    assert row["total_hbm_bytes"] == pytest.approx(100e9)
+    assert row["achieved_flops_per_s"] == pytest.approx(100e9)
+    assert row["flops_util_frac"] == pytest.approx(0.5)
+    assert row["hbm_bw_util_frac"] == pytest.approx(0.5)
+    assert row["arith_intensity"] == pytest.approx(2.0)
+    assert row["bound_by"] == "compute"  # AI == ridge classifies compute
+    dec = doc["phases"]["decode"]
+    assert dec["flops_util_frac"] == pytest.approx(0.5)
+    assert dec["hbm_bw_util_frac"] == pytest.approx(0.5)
+    assert dec["device_time_s"] == pytest.approx(2.0)
+    assert dec["events"] == 2
+
+
+def test_memory_bound_classification():
+    m = _meter()
+    m.index.record("skinny", flops=1e9, hbm_bytes=50e9)  # AI 0.02 << ridge 2
+    m.dispatched("skinny", "chunk")
+    m.processed("chunk", 0.0, now=0.0)
+    m.processed("chunk", 0.0, now=1.0)
+    (row,) = m.block()["executables"]
+    assert row["bound_by"] == "memory"
+
+
+def test_byte_share_apportionment():
+    """Kind device time splits across that kind's executables by share of
+    dispatched HBM bytes: 3:1 bytes => 3:1 device seconds."""
+    m = _meter()
+    m.index.record("big", flops=60e9, hbm_bytes=30e9)
+    m.index.record("small", flops=20e9, hbm_bytes=10e9)
+    m.dispatched("big", "refill")
+    m.dispatched("small", "refill")
+    m.processed("refill", 0.0, now=0.0)
+    m.processed("refill", 0.0, now=4.0)
+    rows = {r["name"]: r for r in m.block()["executables"]}
+    assert rows["big"]["device_time_s"] == pytest.approx(3.0)
+    assert rows["small"]["device_time_s"] == pytest.approx(1.0)
+    assert rows["big"]["phase"] == rows["small"]["phase"] == "admit"
+    # Achieved rates follow the apportioned time, not the kind total.
+    assert rows["big"]["achieved_hbm_bytes_per_s"] == pytest.approx(10e9)
+    assert rows["small"]["achieved_hbm_bytes_per_s"] == pytest.approx(10e9)
+
+
+def test_unknown_executable_counts_dispatches():
+    """No cost entry: fractions stay 0, rows still appear with dispatch
+    counts and time apportioned by dispatch share."""
+    m = _meter()
+    m.dispatched("mystery", "chunk")
+    m.dispatched("mystery", "chunk")
+    m.processed("chunk", 0.0, now=0.0)
+    m.processed("chunk", 0.0, now=1.0)
+    (row,) = m.block()["executables"]
+    assert row["dispatches"] == 2
+    assert row["cost_available"] is False
+    assert row["flops_util_frac"] == 0.0
+    assert row["device_time_s"] == pytest.approx(1.0)
+
+
+def test_wait_floor_on_device_window():
+    """A measured host flag-wait longer than the inter-harvest gap floors
+    the window's device-time estimate."""
+    m = _meter()
+    m.index.record("e", flops=10e9, hbm_bytes=10e9)
+    m.dispatched("e", "chunk")
+    m.processed("chunk", 0.0, now=0.0)
+    m.processed("chunk", 5.0, now=0.001)  # waited 5s on the flags
+    assert m.block()["attributed_device_s"] == pytest.approx(5.0)
+
+
+def test_gauges_flush_on_window():
+    reg = MetricsRegistry()
+    m = _meter(registry=reg, gauge_every=2, replica="7")
+    m.index.record("e", flops=100e9, hbm_bytes=50e9)
+    for _ in range(2):
+        m.dispatched("e", "chunk")
+    m.processed("chunk", 0.0, now=0.0)
+    m.processed("chunk", 0.0, now=1.0)
+    m.processed("chunk", 0.0, now=2.0)  # second busy event: window flushes
+    lab = {"replica": "7", "phase": "decode"}
+    assert reg.value("iat_flops_util_frac", **lab) == pytest.approx(0.5)
+    assert reg.value("iat_hbm_bw_util_frac", **lab) == pytest.approx(0.5)
+    assert reg.value("iat_arith_intensity", **lab) == pytest.approx(2.0)
+
+
+def test_trace_attribution_time_source():
+    """With a ChunkTrace attached, block() joins against the trace's
+    device_busy attribution instead of the meter's windowed estimate."""
+    m = _meter()
+    m.index.record("e", flops=100e9, hbm_bytes=50e9)
+    m.dispatched("e", "chunk")
+    tr = ChunkTrace()
+    # Hand-built event tuples (op, kind, seq, t0, t1) for determinism.
+    tr._ev.append(("beg", None, 0, 0.0, 0.0))
+    tr._ev.append(("disp", "chunk", 0, 0.0, 0.0))
+    tr._ev.append(("proc", "chunk", 0, 2.0, 0.0))
+    tr.n_recorded += 3
+    doc = m.block(trace=tr)
+    assert doc["time_source"] == "trace_attribution"
+    # No land/stall events: the whole 2s interval is device_busy.
+    assert doc["attributed_device_s"] == pytest.approx(2.0)
+    (row,) = doc["executables"]
+    assert row["hbm_bw_util_frac"] == pytest.approx(0.25)
+
+
+# -- CPU smoke: real scheduler with the meter attached ---------------------
+
+
+@pytest.fixture(scope="module", params=["off", "on"])
+def runner(request):
+    """Both scheduled-decode paths: classic (kv_paged=off) and paged."""
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.key(0))
+    return ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4, kv_paged=request.param,
+    )
+
+
+def _sched(runner, n=5, **kw):
+    import numpy as np
+
+    hidden = runner.cfg.hidden_size
+    prompts = [
+        "The quick brown fox. " * 3 + f"Trial {i}?" for i in range(n)
+    ]
+    # Steer starts inside each suffix so the shared prefix stays shareable
+    # (steering from position 0 would force the fixed-batch fallback).
+    starts = [len(p) - 4 for p in prompts]
+    rng = np.random.default_rng(3)
+    vecs = [rng.standard_normal(hidden).astype(np.float32) for _ in range(n)]
+    return runner.generate_grid_scheduled(
+        prompts, [1] * n, vecs, [4.0] * n, max_new_tokens=8,
+        temperature=0.0, steering_start_positions=starts, seed=0,
+        slots=2, **kw,
+    )
+
+
+def test_scheduler_roofline_smoke(runner):
+    base = _sched(runner)
+    tr = ChunkTrace()
+    m = _meter(registry=MetricsRegistry(), peaks=None)
+    out = _sched(runner, trace=tr, roofline=m)
+    assert out == base  # observers change no decoded token
+    doc = m.block(trace=tr)
+    assert doc["time_source"] == "trace_attribution"
+    names = {r["name"] for r in doc["executables"]}
+    if runner.kv_paged == "on":
+        assert "paged_decode_chunk" in names
+        assert "paged_admit" in names
+    else:
+        assert "scheduler_init" in names
+        assert "scheduler_decode_chunk" in names
+        assert "scheduler_refill" in names
+    for row in doc["executables"]:
+        assert row["dispatches"] >= 1
+        assert row["device_time_s"] >= 0.0
+        assert 0.0 <= row["flops_util_frac"]
+        assert 0.0 <= row["hbm_bw_util_frac"]
+    # Per-kind device time is fully apportioned across that kind's rows.
+    per_kind = {}
+    for row in doc["executables"]:
+        per_kind.setdefault(row["kind"], 0.0)
+        per_kind[row["kind"]] += row["device_time_s"]
+    assert sum(per_kind.values()) == pytest.approx(
+        doc["attributed_device_s"], abs=1e-3)
+    assert "decode" in doc["phases"]
+    assert doc["phases"]["decode"]["events"] >= 1
+
+
+def test_batch_path_capture_with_prefix():
+    """The fixed-batch generate path (what the on-device judge drives)
+    cost-indexes under a runner-level name prefix, without changing
+    output; with a trace attached, batch-kind device time falls back to
+    the meter's own estimate instead of reading zero."""
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.key(0))
+    r = ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny",
+        seq_multiple=16, batch_multiple=4,
+    )
+    prompts = ["hello world", "hello there"]
+    base = r.generate_batch(prompts, max_new_tokens=6, temperature=0.0,
+                            seed=0)
+    m = _meter(peaks=None)
+    r.roofline = m
+    r.roofline_prefix = "judge_"
+    out = r.generate_batch(prompts, max_new_tokens=6, temperature=0.0,
+                           seed=0)
+    r.roofline = None
+    assert out == base
+    rows = m.block()["executables"]
+    assert rows and all(x["name"].startswith("judge_generate_tokens")
+                        for x in rows)
+    assert all(x["phase"] == "batch" for x in rows)
+    # Empty trace (no scheduler kinds): batch time survives via fallback.
+    doc = m.block(trace=ChunkTrace())
+    assert doc["time_source"] == "trace_attribution"
+    assert doc["attributed_device_s"] > 0
+
+
+# -- profiler gate ---------------------------------------------------------
+
+
+def test_profiler_capture_and_rate_limit(tmp_path):
+    p = ProfilerPlane(str(tmp_path), min_interval_s=3600.0)
+    doc = p.capture(50)
+    assert doc["duration_ms"] == 50
+    assert doc["xplane_files"], "capture produced no .xplane.pb"
+    assert doc["artifact_bytes"] > 0
+    with pytest.raises(ProfilerRateLimited) as ei:
+        p.capture(50)
+    assert ei.value.retry_after_s > 0
+
+
+def test_profiler_busy(tmp_path):
+    p = ProfilerPlane(str(tmp_path), min_interval_s=0.0)
+    assert p._gate.acquire(blocking=False)
+    try:
+        with pytest.raises(ProfilerBusy):
+            p.capture(10)
+    finally:
+        p._gate.release()
+
+
+def test_profiler_duration_validation(tmp_path):
+    p = ProfilerPlane(str(tmp_path), min_interval_s=0.0, max_duration_ms=20)
+    with pytest.raises(ProfilerError):
+        p.capture(-5)
+    doc = p.capture(10_000)  # clamped, not rejected
+    assert doc["duration_ms"] == 20
+
+
+# -- /profile endpoint -----------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_profile_endpoint(tmp_path):
+    plane = ProfilerPlane(str(tmp_path), min_interval_s=3600.0)
+    srv = MetricsServer(registry=MetricsRegistry(), profiler=plane).start()
+    try:
+        code, doc = _get(f"{srv.url}/profile?duration_ms=50")
+        assert code == 200
+        assert doc["xplane_files"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{srv.url}/profile?duration_ms=50")
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{srv.url}/profile?duration_ms=banana")
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_profile_endpoint_busy_503(tmp_path):
+    plane = ProfilerPlane(str(tmp_path), min_interval_s=0.0)
+    srv = MetricsServer(registry=MetricsRegistry(), profiler=plane).start()
+    try:
+        assert plane._gate.acquire(blocking=False)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{srv.url}/profile")
+            assert ei.value.code == 503
+        finally:
+            plane._gate.release()
+    finally:
+        srv.stop()
+
+
+def test_profile_absent_404_when_unwired():
+    srv = MetricsServer(registry=MetricsRegistry()).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{srv.url}/profile")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+# -- federated timelines ---------------------------------------------------
+
+
+def _traced(events_at):
+    tr = ChunkTrace()
+    tr.begin()
+    for kind, seq in events_at:
+        tr.dispatch(kind, seq)
+        tr.processed(kind, seq)
+    return tr
+
+
+def test_merge_timelines_prefixes_and_disjoint_pids():
+    a = _traced([("chunk", 0)])
+    time.sleep(0.01)
+    b = _traced([("chunk", 0), ("refill", 1)])
+    merged = merge_timelines([
+        ("host0", a.to_perfetto(label="host0")),
+        ("host1", b.to_perfetto(label="host1")),
+    ])
+    ev = merged["traceEvents"]
+    names = [
+        e["args"]["name"] for e in ev
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    ]
+    assert any(n.startswith("host0/") for n in names)
+    assert any(n.startswith("host1/") for n in names)
+    # pid ranges must be disjoint across hosts
+    by_host = {}
+    for e in ev:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            host = e["args"]["name"].split("/")[0]
+            by_host.setdefault(host, set()).add(e["pid"])
+    assert not (by_host["host0"] & by_host["host1"])
+    meta = merged.get("metadata", {})
+    assert meta.get("merged_from") == ["host0", "host1"]
+    # host1 began later; wall-clock alignment puts its earliest event
+    # after host0's (the earliest anchor is the merged ts origin).
+    first_ts = {}
+    for e in ev:
+        if "ts" in e and e.get("ph") != "M":
+            host = None
+            for h, pids in by_host.items():
+                if e["pid"] in pids:
+                    host = h
+            if host is not None:
+                first_ts[host] = min(first_ts.get(host, e["ts"]), e["ts"])
+    assert first_ts["host0"] <= first_ts["host1"]
+
+
+def test_serve_trace_id_deterministic():
+    """Request-scoped trace ids derive from rid alone, so crash recovery
+    recomputes the same id without persisting it in the journal spec."""
+    from introspective_awareness_tpu.serve.engine import ResponseStream
+    from introspective_awareness_tpu.serve.request import SteerRequest
+
+    def mk():
+        return SteerRequest(
+            rid="req-00042", tenant="t0", priority="normal",
+            prompt="hello", vector="v", layer=1, strength=1.0,
+            steer_start=0, max_new_tokens=4, temperature=0.0,
+        )
+
+    a = ResponseStream(mk(), trial=None, stream_id=0)
+    b = ResponseStream(mk(), trial=None, stream_id=1)
+    assert a.trace_id == b.trace_id
+    assert a.trace_id.startswith("r") and len(a.trace_id) == 9
+
+
+def test_chunktrace_tok_events_render():
+    tr = ChunkTrace()
+    tr.begin()
+    tr.dispatch("chunk", 0)
+    tr.processed("chunk", 0)
+    tr.tokens("rdeadbeef", 3)
+    doc = tr.to_perfetto()
+    inst = [e for e in doc["traceEvents"] if e.get("ph") == "i"]
+    assert any(e.get("args", {}).get("trace_id") == "rdeadbeef"
+               and e.get("args", {}).get("n") == 3 for e in inst)
+    # unknown-op safety: attribution skips tok events
+    assert isinstance(tr.attribution(), list)

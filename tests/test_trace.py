@@ -89,7 +89,11 @@ class TestRingBuffer:
         assert tr.attribution() == []
         s = tr.summary()
         assert s["chunks"] == 0 and s["fractions_sum"] is None
-        assert tr.to_perfetto() == {"traceEvents": [], "displayTimeUnit": "ms"}
+        doc = tr.to_perfetto()
+        assert doc["traceEvents"] == []
+        assert doc["displayTimeUnit"] == "ms"
+        # merge anchors ride along even when empty, but stay null
+        assert doc["metadata"]["unix_base_s"] is None
 
 
 class TestAttribution:
@@ -209,7 +213,7 @@ class TestPerfetto:
             ("gret", None, 2, 1.4, 1.9),
         ])
         doc = tr.to_perfetto()
-        assert set(doc) == {"traceEvents", "displayTimeUnit"}
+        assert set(doc) == {"traceEvents", "displayTimeUnit", "metadata"}
         evs = doc["traceEvents"]
 
         metas = [e for e in evs if e["ph"] == "M"]
