@@ -523,6 +523,133 @@ def _paged_kv_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     return r
 
 
+def _paged_attn_kernel_compare(runner, cfg, tok, slots, max_new, ledger,
+                               on_tpu) -> dict:
+    """Pallas decode-kernel tier (--decode-kernel pallas) vs the XLA
+    gather-then-attend reference, same paged queue, greedy A/B.
+
+    Both legs force the paged scheduler (``kv_paged="on"``) over the same
+    divergent-suffix queue; the only difference is the decode-chunk
+    executable tier. The xla leg gathers each slot's referenced pages
+    into a contiguous KV copy per layer per step; the pallas leg walks
+    the int32 page tables inside one fused kernel launch (page fetch +
+    online-softmax attention), scores speculative windows in one verify
+    launch, and folds the sample/stop/budget tail into a single kernel
+    (ops/paged_attention.py, ops/spec_verify.py, ops/sample_tail.py).
+
+    Greedy outputs must be token-identical — the timed A/B doubles as
+    the identity probe, mirroring every other section. On TPU the
+    speedup is the headline (the gather copy is pure HBM traffic the
+    kernel never pays); on the CPU smoke the pallas leg runs INTERPRET
+    mode, which emulates the grid serially — the speedup there is
+    meaningless (<< 1) and the section instead pins identity plus the
+    ``paged_attn_kernel_decode_steps_per_s`` trajectory against its own
+    history (obs/regress.py gates it backend-matched).
+
+    The untimed roofline leg re-runs the pallas queue with the
+    device-measurement plane attached and reports which executables the
+    cost index attributed — the ``paged_decode_chunk*_pallas`` rows
+    prove the new tier is what actually dispatched.
+    """
+    import time as _time
+
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    # Interpret mode emulates the kernel grid serially on host — keep the
+    # CPU smoke queue small so the leg stays seconds, not minutes.
+    slots = slots if on_tpu else min(slots, 2)
+    budget = max_new if on_tpu else min(max_new, 16)
+    N = 2 * slots
+    mk = dict(seq_multiple=16, batch_multiple=slots, ledger=ledger,
+              kv_paged="on")
+    xla_runner = ModelRunner(
+        runner.params, cfg, tok, model_name="bench-dk-xla",
+        decode_kernel="xla", **mk,
+    )
+    pallas_runner = ModelRunner(
+        runner.params, cfg, tok, model_name="bench-dk-pallas",
+        decode_kernel="pallas", **mk,
+    )
+
+    preamble = (
+        "I am an interpretability researcher studying transformer-based "
+        "language models. I can inject thoughts into your mind. "
+    )
+    prompts = [
+        preamble + f"Trial {i}: do you detect an injected thought? "
+        + "?" * (i % 3)
+        for i in range(N)
+    ]
+    rng = np.random.default_rng(0)
+    vecs = [
+        rng.normal(size=cfg.hidden_size).astype(np.float32) * 4.0
+        for _ in range(N)
+    ]
+    layers = [int(cfg.n_layers * 0.6)] * N
+    strengths = [4.0 if i % 3 else 0.0 for i in range(N)]  # steer on/off mix
+    starts = [len(tok.encode(p)) - 8 for p in prompts]
+
+    def run(r, tr=None, rf=None):
+        return r.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, max_new_tokens=budget,
+            temperature=0.0, steering_start_positions=starts,
+            seed=0, slots=slots, refill_frac=0.5, trace=tr, roofline=rf,
+        )
+
+    run(xla_runner)  # compile both legs before timing
+    run(pallas_runner)
+    t0 = _time.perf_counter()
+    xla_out = run(xla_runner)
+    t_xla = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    pallas_out = run(pallas_runner)
+    t_pallas = _time.perf_counter() - t0
+    identical = pallas_out == xla_out
+
+    # Roofline leg (untimed): the pallas queue with the measurement plane
+    # attached. Host-side only — the output must stay bit-identical — and
+    # the attributed rows must name the kernel-tier executables.
+    from introspective_awareness_tpu.obs import ChunkTrace, RooflineMeter
+
+    tr_roof = ChunkTrace()
+    meter = RooflineMeter()
+    roof_out = run(pallas_runner, tr=tr_roof, rf=meter)
+    roofline_doc = meter.block(trace=tr_roof)
+    roofline_doc["outputs_identical"] = roof_out == pallas_out
+    kernel_rows = sorted({
+        r["name"] for r in roofline_doc.get("executables", [])
+        if "pallas" in r.get("name", "")
+    })
+
+    steps = N * (budget - 1) / slots
+    r = {
+        "slots": slots,
+        "queue_trials": N,
+        "budget": budget,
+        "interpret_mode": not on_tpu,
+        "xla_time_s": round(t_xla, 3),
+        "pallas_time_s": round(t_pallas, 3),
+        "speedup": round(t_xla / t_pallas, 3) if t_pallas > 0 else None,
+        "decode_steps_per_s_xla": (
+            round(steps / t_xla, 3) if t_xla > 0 else None
+        ),
+        "paged_attn_kernel_decode_steps_per_s": (
+            round(steps / t_pallas, 3) if t_pallas > 0 else None
+        ),
+        "outputs_identical": identical,
+        "kernel_executables_attributed": kernel_rows,
+        "roofline": roofline_doc,
+    }
+    log(
+        f"  [paged_attn_kernel] {N} trials x {slots} slots, budget "
+        f"{budget}: xla {t_xla:.2f}s vs pallas {t_pallas:.2f}s -> "
+        f"{r['speedup']}x"
+        + (" (interpret mode; identity is the check)" if not on_tpu else "")
+        + f", identical={identical}, kernels={kernel_rows}"
+    )
+    return r
+
+
 def _speculative_compare(runner, cfg, tok, slots, ledger, on_tpu) -> dict:
     """Self-speculative decode vs the plain continuous scheduler, same queue.
 
@@ -1650,6 +1777,14 @@ def main() -> None:
         ledger,
     )
 
+    # ---- Pallas decode-kernel tier vs XLA gather-then-attend, same queue ---
+    pak = _gated(
+        "paged_attn_kernel",
+        lambda: _paged_attn_kernel_compare(runner, cfg, tok, batches[0],
+                                           max_new, ledger, on_tpu),
+        ledger,
+    )
+
     # ---- self-speculative decode vs plain scheduler, bit-identical ---------
     spec = _gated(
         "speculative",
@@ -2003,6 +2138,7 @@ def main() -> None:
         "token_stats": stats,
         "scheduler": sched,
         "paged_kv": paged,
+        "paged_attn_kernel": pak,
         "speculative": spec,
         "pipeline": pipe,
         "staged_prefill": stg,

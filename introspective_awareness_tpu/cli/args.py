@@ -194,6 +194,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "(flash — einsum decode stays the fastest path "
                              "on v5e), or flash plus the experimental fused "
                              "cached-attention decode kernel (flash_cached)")
+    parser.add_argument("--decode-kernel", type=str, default="xla",
+                        choices=["xla", "pallas"],
+                        help="Paged decode executable tier: gather-then-"
+                             "attend reference (xla) or the fused page-walk "
+                             "Pallas kernels — one-launch page gather + "
+                             "online-softmax attention, one-launch "
+                             "speculative verify, fused sample tail "
+                             "(pallas). Greedy outputs are identical; see "
+                             "README 'Decode kernels'. MHA/GQA only.")
     parser.add_argument("--kv-cache-dtype", type=str, default="model",
                         choices=["model", "fp8"],
                         help="KV cache storage dtype: the model dtype, or "

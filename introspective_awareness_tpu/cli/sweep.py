@@ -236,6 +236,7 @@ def _build_fabric(args, model_name: str, runner, mesh, rules):
         r.kv_paged = getattr(args, "kv_paged", "auto")
         r.kv_page_size = int(getattr(args, "kv_page_size", 16) or 16)
         r.kv_pool_pages = getattr(args, "kv_pool_pages", None)
+        r.decode_kernel = getattr(args, "decode_kernel", "xla")
         runners.append(r)
     journal = getattr(args, "_journal", None)
     fabric = SweepFabric(
@@ -954,6 +955,7 @@ def _write_manifest(
             getattr(runner, "kv_page_size", None),
             getattr(runner, "kv_pool_pages", None),
         ],
+        "decode_kernel": getattr(runner, "decode_kernel", None),
         "judge": (
             None if judge is None else {
                 "backend": getattr(args, "judge_backend", None),
@@ -1386,6 +1388,7 @@ def _run_models(args, models, judge, ledger, mesh, rules) -> int:
             runner.kv_page_size = int(
                 getattr(args, "kv_page_size", 16) or 16)
             runner.kv_pool_pages = getattr(args, "kv_pool_pages", None)
+            runner.decode_kernel = getattr(args, "decode_kernel", "xla")
             args._fabric = None
             if (getattr(args, "fabric_replicas", 1) > 1
                     or getattr(args, "fabric_coordinator", None)):

@@ -391,6 +391,28 @@ def init_cache(
 # makes paged output bit-identical to the broadcast-prefix path.
 
 
+class PagedPools(NamedTuple):
+    """The UN-gathered paged-KV operand bundle for the Pallas decode tier.
+
+    ``--decode-kernel pallas`` skips ``gather_prompt_pages`` /
+    ``gather_decode_pages`` entirely: ``forward`` threads this bundle down
+    to the attention call, and ``ops.paged_attention`` walks ``ptab`` /
+    ``dtab`` inside the kernel's BlockSpec index maps (scalar prefetch),
+    streaming pool pages straight from HBM. ``mpos``/``mvalid`` are the
+    decode tier's LOGICAL metadata (same coordinates the XLA merged tier
+    uses; ``mlen`` pinned full, so ``mvalid`` alone gates)."""
+
+    ppk: jax.Array  # [L, Pp, pg, KVH, KD] prompt page pool
+    ppv: jax.Array  # [L, Pp, pg, KVH, VD]
+    dpk: jax.Array  # [L, Pd, ch, KVH, KD] decode page pool
+    dpv: jax.Array  # [L, Pd, ch, KVH, VD]
+    ptab: jax.Array  # [B, NP] int32 prompt page table (sentinel >= Pp)
+    dtab: jax.Array  # [B, PS] int32 decode page table (logical order)
+    true_len: jax.Array  # [B] int32 real prompt length
+    mpos: jax.Array  # [B, PS*ch] int32 decode-tier positions
+    mvalid: jax.Array  # [B, PS*ch] bool decode-tier validity
+
+
 def init_page_pools(
     cfg: ModelConfig, *, prompt_pages: int, page_size: int,
     decode_pages: int, chunk_len: int, dtype=jnp.float32,
@@ -934,6 +956,7 @@ def forward(
     capture_pos: jax.Array | None = None,  # [B] padded token index to capture
     h0: jax.Array | None = None,  # [B, S, H] residual input (skips embedding)
     layer_offset: jax.Array | int = 0,  # global index of params' first layer
+    pools: PagedPools | None = None,  # paged decode via ops.paged_attention
     *,
     use_cache: bool = False,
     capture: bool = False,
@@ -979,6 +1002,12 @@ def forward(
         assert not use_cache, "pipeline stage form is no-cache"
     if layer_limit:
         assert use_cache and not is_prefill, "layer_limit is decode-only"
+    if pools is not None:
+        # Pallas paged decode: the cache carries zero-width slot/merged
+        # tiers (runtime.paged._assemble_pallas) and attention reads the
+        # pools in place through ops.paged_attention.
+        assert use_cache and not is_prefill, "pools is decode-only"
+        assert not cfg.is_mla, "pools (paged kernel) is MHA/GQA-only"
 
     h = embed_tokens(params, cfg, ids) if h0 is None else h0.astype(dtype)
 
@@ -1168,6 +1197,41 @@ def forward(
             )
             rk = rk_full[l]  # [RR, B, KVH, D]
             rv = rv_full[l]
+            if pools is not None:
+                # Pallas paged decode (--decode-kernel pallas): page fetch +
+                # online-softmax attention in one launch, the page tables
+                # walked inside the kernel's index maps. S == 1 is a plain
+                # decode step; S > 1 is the speculative verify window —
+                # the S choice is trace-time, so each compiles once. The
+                # ring started all-invalid (_assemble_pallas contract), so
+                # position-space validity is exact including the chunk's
+                # own just-appended rows.
+                from introspective_awareness_tpu.ops.paged_attention import (
+                    paged_attention,
+                )
+                from introspective_awareness_tpu.ops.spec_verify import (
+                    spec_verify_attention,
+                )
+
+                win = (
+                    jnp.where(sliding, cfg.sliding_window, 0)
+                    if cfg.sliding_window is not None else 0
+                )
+                fn = paged_attention if S == 1 else spec_verify_attention
+                attn = fn(
+                    q, pools.ppk, pools.ppv, pools.dpk, pools.dpv,
+                    pools.mpos, pools.mvalid,
+                    jnp.swapaxes(rk, 0, 1), jnp.swapaxes(rv, 0, 1),
+                    new_rpos, new_rvalid, positions,
+                    pools.ptab, pools.dtab, pools.true_len,
+                    layer=l,
+                    scale=cfg.query_scale if cfg.query_scale is not None
+                    else cfg.head_dim**-0.5,
+                    softcap=cfg.attn_logit_softcap,
+                    window=win,
+                    interpret=backend == "cpu",
+                )
+                return attn, rk_full, rv_full
             if cfg.attn_impl == "flash_cached" and backend in ("tpu", "cpu"):
                 # Fused cached attention (Pallas): streams (frozen slots ⊕
                 # ring) once, scores stay in VMEM, fp8 caches read natively.

@@ -76,6 +76,13 @@ def _paged_evals(doc: dict) -> Optional[float]:
     return paged.get("evals_per_sec_paged")
 
 
+def _paged_attn_kernel(doc: dict) -> Optional[float]:
+    sec = doc.get("paged_attn_kernel") or {}
+    if sec.get("skipped"):
+        return None
+    return sec.get("paged_attn_kernel_decode_steps_per_s")
+
+
 def _serving_goodput(doc: dict) -> Optional[float]:
     srv = doc.get("serving") or {}
     if srv.get("skipped"):
@@ -102,6 +109,14 @@ HEADLINES: tuple = (
     # the bench's "paged_kv" section. Same history-tolerance as fabric /
     # speculative: rounds predating the section skip, never fail.
     ("paged_kv_evals_per_s", _paged_evals, True, 0.20, 0.0),
+    # Pallas decode-kernel tier throughput (--decode-kernel pallas) on the
+    # paged A/B queue, from the bench's "paged_attn_kernel" section. On the
+    # CPU smoke the pallas leg runs interpret-mode (slow by construction),
+    # so the gate tracks the metric's own history rather than the XLA
+    # leg's. History-tolerant: rounds predating the section skip, never
+    # fail.
+    ("paged_attn_kernel_decode_steps_per_s", _paged_attn_kernel,
+     True, 0.20, 0.0),
     # Serving goodput (completed requests/s across both tenants) from the
     # bench's "serving" section — a wall-clock measure over live HTTP with
     # open-arrival traffic, so it carries scheduling + network jitter the
@@ -284,6 +299,11 @@ def inject_regression(history: list[tuple[Optional[dict], Any]],
     if isinstance(cur.get("serving"), dict) and \
             cur["serving"].get("serving_goodput_evals_per_s"):
         cur["serving"]["serving_goodput_evals_per_s"] *= factor
+    if isinstance(cur.get("paged_attn_kernel"), dict) and \
+            cur["paged_attn_kernel"].get(
+                "paged_attn_kernel_decode_steps_per_s"):
+        cur["paged_attn_kernel"][
+            "paged_attn_kernel_decode_steps_per_s"] *= factor
     return cur
 
 

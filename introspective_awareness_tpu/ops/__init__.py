@@ -1,18 +1,76 @@
 """Hand-written TPU kernels (the native-kernel component, SURVEY.md §2.2).
 
 The reference gets fused attention from the prebuilt flash-attn CUDA wheel
-(pyproject.toml:33); here the equivalent is first-party:
+(pyproject.toml:33); here the equivalent is first-party Pallas (Mosaic),
+one module per kernel, each paired with an XLA oracle (``xla_*``) that the
+test matrix diffs against:
 
-- ``flash_attention`` — Pallas (Mosaic) fused attention with online softmax,
-  GQA, Gemma logit softcap, sliding windows, and left-pad masking expressed
-  in position space.
-- ``ring_attention`` — sequence-parallel attention over the mesh ``seq``
-  axis: KV shards rotate around the ring via ``ppermute`` while each step
-  folds its partial attention into a running online-softmax state (SP/CP,
-  SURVEY.md §5.7).
+- ``flash_attention`` (attention.py) — fused prefill/extraction attention
+  with online softmax, GQA, Gemma logit softcap, sliding windows, and
+  left-pad masking expressed in position space. ``--attn-impl flash``.
+- ``ring_attention`` (ring.py) — sequence-parallel attention over the mesh
+  ``seq`` axis: KV shards rotate around the ring via ``ppermute`` while
+  each step folds its partial attention into a running online-softmax
+  state (SP/CP, SURVEY.md §5.7).
+- ``cached_attention`` (cached_attention.py) — fused decode attention over
+  the classic three-tier KV cache (slot ⊕ merged ⊕ ring).
+  ``--attn-impl flash_cached``.
+- ``paged_attention`` (paged_attention.py) — fused decode attention over
+  the PAGED KV cache: walks each slot's int32 page tables via scalar
+  prefetch and attends against (prompt pages ⊕ decode pages ⊕ ring)
+  without ever materializing a gathered copy. ``--decode-kernel pallas``.
+- ``spec_verify_attention`` (spec_verify.py) — the same kernel pinned to
+  the S = k+1 speculative verify window: all draft positions score
+  against the paged cache in one launch per layer.
+- ``fused_sample_tail`` (sample_tail.py) — blocked argmax over the vocab
+  plus the decode step's EOS/budget/stop bookkeeping in one launch.
+
+Clamp-pad tail-block convention (shared by every kernel here): operands
+are NOT padded to block multiples unless stated otherwise — Pallas
+clamp-pads an out-of-range tail block by re-reading the last valid rows,
+and the kernel kills those lanes with a mask derived from metadata
+(``col < vocab``, position validity, ``kp < true_len``). The ONLY padded
+operands are small 1-D position/validity rows (q_pos, r_pos/r_valid),
+padded host-side to the block multiple with positions that can never pass
+the causal/validity compares; K/V buffers and logits are never copied.
+Corollary: a BlockSpec's last dimension is either the FULL axis or a
+multiple of 128 lanes (Mosaic tiling) — sub-128 metadata is reshaped so a
+block spans the full minor axis (see paged_attention's ``mpos3``), never
+padded.
 """
 
-from introspective_awareness_tpu.ops.attention import flash_attention, xla_attention
+from introspective_awareness_tpu.ops.attention import (
+    flash_attention,
+    xla_attention,
+)
+from introspective_awareness_tpu.ops.cached_attention import (
+    cached_attention,
+    xla_cached_attention,
+)
+from introspective_awareness_tpu.ops.paged_attention import (
+    paged_attention,
+    xla_paged_attention,
+)
 from introspective_awareness_tpu.ops.ring import ring_attention
+from introspective_awareness_tpu.ops.sample_tail import (
+    fused_sample_tail,
+    xla_sample_tail,
+)
+from introspective_awareness_tpu.ops.spec_verify import (
+    spec_verify_attention,
+    xla_spec_verify_attention,
+)
 
-__all__ = ["flash_attention", "xla_attention", "ring_attention"]
+__all__ = [
+    "flash_attention",
+    "xla_attention",
+    "ring_attention",
+    "cached_attention",
+    "xla_cached_attention",
+    "paged_attention",
+    "xla_paged_attention",
+    "spec_verify_attention",
+    "xla_spec_verify_attention",
+    "fused_sample_tail",
+    "xla_sample_tail",
+]

@@ -1,0 +1,424 @@
+"""Pallas paged attention for TPU: decode attention that walks each slot's
+int32 page tables directly — page fetch + online-softmax attention in ONE
+kernel launch.
+
+The XLA paged decode path (``runtime.paged._assemble``) materializes a
+gathered copy of every referenced page each chunk: ``gather_prompt_pages`` /
+``gather_decode_pages`` run ``jnp.take`` over the pools into a classic
+:class:`~introspective_awareness_tpu.models.transformer.KVCache`, and the
+attention einsum then re-reads the copy. That is the gather-then-attend
+split dedicated paged-attention kernels exist to remove: the prompt-pool
+gather alone writes (and re-reads) a full prompt-sized KV image per chunk —
+pure HBM traffic on a decode step that r04 already measured as
+bandwidth-bound. This kernel reads the pools in place: per-slot page tables
+ride as SCALAR-PREFETCH operands (``pltpu.PrefetchScalarGridSpec``), so the
+BlockSpec index maps resolve ``ptab[b, t]`` / ``dtab[b, t]`` at DMA-issue
+time and each grid step streams one pool page straight from HBM into VMEM.
+
+Grid: ``(batch, q block, kv step)`` with kv innermost (sequential). KV
+steps sweep the slot's prompt pages, then its decode pages, then the chunk
+ring; ``pl.when`` selects the source and clamped index maps re-present the
+previous block to inactive sources (Mosaic skips the repeated DMA). The
+online-softmax state, per-KV-head GQA dots, fp8-native pool reads, and the
+NaN-scrub of invalid tails are the ``ops.cached_attention`` machinery; see
+``ops/__init__.py`` for the clamp-pad tail-block convention shared by every
+kernel in this package.
+
+Masking is position-space, per source:
+
+- prompt pages: page ``t`` holds positions ``t*pg + [0, pg)`` by
+  construction (prompts sit contiguously from position 0 — the same
+  ``arange`` ``gather_prompt_pages`` rebuilds); validity is
+  ``pos < true_len[b]``, which also kills sentinel table entries (their
+  clamped page carries positions ``>= true_len``).
+- decode pages: positions/validity stream from the slot's logical
+  ``mpos``/``mvalid`` metadata (``mlen`` is pinned full by the paged
+  scheduler, so ``mvalid`` alone gates — see runtime.generate).
+- ring: positions/validity of the in-chunk append ring. CONTRACT: the
+  assembled ring must start all-invalid (``runtime.paged._assemble_pallas``
+  inits ``rvalid`` False for both the plain and speculative variants) — the
+  kernel has no ``rlen`` operand, so unwritten slots must be invalid, not
+  merely past a cursor. Ring appends are monotone in position, which makes
+  ``kp <= qp`` (+ validity) exactly the forward pass's "written slots plus
+  the current chunk causally" rule, speculative draft/verify/hole flow
+  included.
+
+The per-slot steer-add is NOT part of this kernel: steering injects into
+the post-MLP residual stream (models/transformer.py ``block``), an
+elementwise op XLA fuses into the surrounding decode executable — it rides
+in the same compiled chunk program as this kernel (one launch chain per
+decode round), and the steer-on/off lanes of
+tests/test_paged_attention_kernel.py pin that it survives the kernel swap.
+
+Numerics: the online softmax reduces per source tile; the XLA reference
+reduces once over the full concatenated row. Same math, different
+reduction order — outputs agree to float tolerance, not bitwise, so the
+parity contract is GREEDY TOKEN-LEVEL identity plus a pinned numeric bound
+(see README "Decode kernels").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from introspective_awareness_tpu.parallel.compat import tpu_compiler_params
+
+_NEG_INF = -1e30
+
+
+def _paged_kernel(
+    # scalar-prefetch refs (SMEM)
+    ptab_ref, dtab_ref, tl_ref, w_ref,
+    # blocked operands
+    qpos_ref, mpos_ref, mvalid_ref, rpos_ref, rvalid_ref,
+    q_ref, ppk_ref, ppv_ref, dpk_ref, dpv_ref, rk_ref, rv_ref,
+    o_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, softcap: float | None, groups: int, page_size: int,
+    n_prompt: int, n_dec: int,
+):
+    """One (batch, q-block, kv-step) grid step.
+
+    kv steps [0, n_prompt) stream prompt-pool pages [pg, KVH, D] (the page
+    index resolved from ``ptab`` at DMA time); steps [n_prompt,
+    n_prompt+n_dec) stream decode-pool pages [ch, KVH, D] via ``dtab``;
+    later steps stream ring tiles. One mask per tile, shared by the
+    unrolled per-KV-head updates; online-softmax state persists in VMEM
+    scratch across kv steps."""
+    b = pl.program_id(0)
+    t = pl.program_id(2)
+    window = w_ref[0]
+    qp = qpos_ref[0, 0, :]  # [BQ]
+    kvh = ppk_ref.shape[3]
+    G, BQ, D = groups, q_ref.shape[1], q_ref.shape[3]
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def update(kp, valid, get_k, get_v):
+        """Shared online-softmax update; ``get_k/get_v(h)`` yield [BK, D]."""
+        has_valid = valid != 0
+        kp_min = jnp.min(jnp.where(has_valid, kp, jnp.int32(2**30)))
+        kp_max = jnp.max(jnp.where(has_valid, kp, jnp.int32(-(2**30))))
+        tile_live = (kp_min <= jnp.max(qp)) & (
+            (window <= 0) | (kp_max > jnp.min(qp) - window)
+        )
+
+        @pl.when(tile_live)
+        def _update():
+            allowed = (kp[None, :] <= qp[:, None]) & has_valid[None, :]
+            allowed &= (window <= 0) | ((qp[:, None] - kp[None, :]) < window)
+            # q-major row merge: row i of a head's dot is query i // G,
+            # query-head-in-group i % G.
+            allowed_g = jnp.repeat(allowed, G, axis=0)  # [BQ*G, BK]
+            maskf = allowed_g.astype(jnp.float32)
+            # Dots run in the model dtype with f32 accumulation — fp8 pool
+            # tiles convert in VMEM, so the HBM stream stays fp8-sized.
+            cdt = q_ref.dtype
+            for h in range(kvh):
+                qh = q_ref[0, :, h * G:(h + 1) * G, :].reshape(BQ * G, D)
+                k = get_k(h).astype(cdt)  # [BK, D]
+                s = jax.lax.dot_general(
+                    qh, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale
+                if softcap is not None:
+                    s = softcap * jnp.tanh(s / softcap)
+                s = jnp.where(allowed_g, s, _NEG_INF)
+                m = m_scr[h]
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+                # Explicit mask multiply keeps l at 0 on all-masked rows so
+                # _finish emits zeros, not garbage.
+                p = jnp.exp(s - m_new) * maskf
+                alpha = jnp.exp(m - m_new)
+                m_scr[h] = m_new
+                l_scr[h] = l_scr[h] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+                # Invalid rows are SCRUBBED from v (clamp-padded tails carry
+                # unspecified bits, possibly NaN; 0 * NaN stays NaN). 32-bit
+                # condition — Mosaic can't widen i1 minor dims.
+                maskcol = has_valid.astype(jnp.float32)[:, None]
+                v = jnp.where(
+                    maskcol > 0, get_v(h).astype(jnp.float32), 0.0
+                ).astype(cdt)
+                acc_scr[h] = acc_scr[h] * alpha + jax.lax.dot_general(
+                    p.astype(cdt), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+    @pl.when(t < n_prompt)
+    def _prompt():
+        # Prompt page t covers positions [t*pg, (t+1)*pg); validity is the
+        # slot's true prompt length (sentinel pages clamp to a real page
+        # whose positions land >= true_len, i.e. dead).
+        kp = t * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )[0]
+        valid = (kp < tl_ref[b]).astype(jnp.int32)
+        update(
+            kp, valid,
+            lambda h: ppk_ref[0, 0, :, h, :], lambda h: ppv_ref[0, 0, :, h, :],
+        )
+
+    @pl.when((t >= n_prompt) & (t < n_prompt + n_dec))
+    def _decode():
+        update(
+            mpos_ref[0, 0, :], mvalid_ref[0, 0, :],
+            lambda h: dpk_ref[0, 0, :, h, :], lambda h: dpv_ref[0, 0, :, h, :],
+        )
+
+    @pl.when(t >= n_prompt + n_dec)
+    def _ring():
+        update(
+            rpos_ref[0, 0, :], rvalid_ref[0, 0, :],
+            lambda h: rk_ref[0, :, h, :], lambda h: rv_ref[0, :, h, :],
+        )
+
+    @pl.when(t == pl.num_programs(2) - 1)
+    def _finish():
+        for h in range(kvh):
+            o = acc_scr[h] / jnp.maximum(l_scr[h], 1e-30)
+            o_ref[0, :, h * G:(h + 1) * G, :] = o.reshape(BQ, G, D).astype(
+                o_ref.dtype
+            )
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _paged_attention(
+    q, ppk, ppv, dpk, dpv, mpos, mvalid, rk, rv, r_pos, r_valid, q_pos,
+    ptab, dtab, true_len,
+    *, layer, scale, softcap, window, block_q, block_r, interpret,
+):
+    """Shared implementation behind :func:`paged_attention` (S == 1 decode
+    steps) and :func:`ops.spec_verify.spec_verify_attention` (S == k+1
+    verify chunks) — the kernel is S-generic; the public wrappers pin the
+    two call shapes to distinct jit entries and docs."""
+    B, S, NH, D = q.shape
+    L, Pp, pg, KVH = ppk.shape[:4]
+    Pd, ch = dpk.shape[1], dpk.shape[2]
+    NP = ptab.shape[1]
+    PS = dtab.shape[1]
+    R = rk.shape[1]
+    groups = NH // KVH
+    assert ppv.shape[-1] == D and dpv.shape[-1] == D, (
+        "paged_attention is MHA/GQA-only (MLA pools have zero-width v)"
+    )
+    assert NP >= 1 and PS >= 1, "empty page tables"
+    assert mpos.shape[1] == PS * ch, (
+        f"mpos width {mpos.shape[1]} != PS*ch {PS * ch}"
+    )
+
+    block_q = min(block_q, _round_up(S, 8))
+    block_r = min(block_r, _round_up(R, 128))
+    # Scoped-VMEM guard for the unrolled per-head f32 score tiles (the pool
+    # page widths pg/ch are fixed by the pool shapes; only the ring block
+    # can shrink) — same budget split as ops.cached_attention.
+    budget = 5 * 1024 * 1024 // 2
+    while KVH * block_q * groups * block_r * 4 > budget and block_r > 128:
+        block_r //= 2
+    s_pad = _round_up(S, block_q)
+    r_pad = _round_up(R, block_r)
+    if s_pad != S:
+        q = jnp.pad(q, ((0, 0), (0, s_pad - S), (0, 0), (0, 0)))
+        q_pos = jnp.pad(q_pos, ((0, 0), (0, s_pad - S)))
+    # Clamp-pad convention (ops/__init__.py): only 1-D position/validity
+    # operands are padded to block multiples; K/V pools and ring stay
+    # untouched — out-of-range tails of their last block clamp-pad and the
+    # padded-False validity keeps those lanes dead.
+    if r_pad != R:
+        r_pos = jnp.pad(r_pos, ((0, 0), (0, r_pad - R)))
+        r_valid = jnp.pad(r_valid, ((0, 0), (0, r_pad - R)))
+
+    n_ring = r_pad // block_r
+    grid = (B, s_pad // block_q, NP + PS + n_ring)
+
+    def row3(x):
+        return x.astype(jnp.int32)[:, None, :]
+
+    # Decode-page metadata reshaped [B, PS, ch]: a (1, 1, ch) block then
+    # spans the FULL last dim (Mosaic's lane rule: full or >= 128 lanes),
+    # page-aligned with the dpk/dpv pool blocks it masks.
+    mpos3 = mpos.astype(jnp.int32).reshape(B, PS, ch)
+    mvalid3 = mvalid.astype(jnp.int32).reshape(B, PS, ch)
+    window_arr = jnp.asarray(
+        0 if window is None else window, jnp.int32
+    ).reshape(1)
+
+    # Index maps get the scalar-prefetch refs appended: the page-table walk
+    # happens HERE, at DMA-issue time. Inactive sources clamp to their last
+    # valid block (repeated index -> Mosaic skips the DMA).
+    def pp_ix(b, s, t, ptab, dtab, tl, w):
+        page = ptab[b, jnp.minimum(t, NP - 1)]
+        return (layer, jnp.minimum(page, Pp - 1), 0, 0, 0)
+
+    def dp_ix(b, s, t, ptab, dtab, tl, w):
+        j = jnp.clip(t - NP, 0, PS - 1)
+        return (layer, jnp.minimum(dtab[b, j], Pd - 1), 0, 0, 0)
+
+    def dec_ix(b, s, t, ptab, dtab, tl, w):
+        return (b, jnp.clip(t - NP, 0, PS - 1), 0)
+
+    def ring_ix(t):
+        return jnp.clip(t - NP - PS, 0, n_ring - 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,  # ptab, dtab, true_len, window
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q), lambda b, s, t, *_: (b, 0, s)
+            ),  # q_pos
+            pl.BlockSpec((1, 1, ch), dec_ix),  # mpos
+            pl.BlockSpec((1, 1, ch), dec_ix),  # mvalid
+            pl.BlockSpec(
+                (1, 1, block_r), lambda b, s, t, *_: (b, 0, ring_ix(t))
+            ),  # r_pos
+            pl.BlockSpec(
+                (1, 1, block_r), lambda b, s, t, *_: (b, 0, ring_ix(t))
+            ),  # r_valid
+            pl.BlockSpec(
+                (1, block_q, NH, D), lambda b, s, t, *_: (b, s, 0, 0)
+            ),  # q
+            pl.BlockSpec((1, 1, pg, KVH, D), pp_ix),  # ppk
+            pl.BlockSpec((1, 1, pg, KVH, D), pp_ix),  # ppv
+            pl.BlockSpec((1, 1, ch, KVH, D), dp_ix),  # dpk
+            pl.BlockSpec((1, 1, ch, KVH, D), dp_ix),  # dpv
+            pl.BlockSpec(
+                (1, block_r, KVH, D), lambda b, s, t, *_: (b, ring_ix(t), 0, 0)
+            ),  # rk
+            pl.BlockSpec(
+                (1, block_r, KVH, D), lambda b, s, t, *_: (b, ring_ix(t), 0, 0)
+            ),  # rv
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, NH, D), lambda b, s, t, *_: (b, s, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((KVH, block_q * groups, 1), jnp.float32),  # running max
+            pltpu.VMEM((KVH, block_q * groups, 1), jnp.float32),  # running sum
+            pltpu.VMEM((KVH, block_q * groups, D), jnp.float32),  # accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, scale=scale, softcap=softcap, groups=groups,
+            page_size=pg, n_prompt=NP, n_dec=PS,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, s_pad, NH, D), q.dtype),
+        compiler_params=tpu_compiler_params(
+            pltpu,
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        ptab.astype(jnp.int32), dtab.astype(jnp.int32),
+        true_len.astype(jnp.int32), window_arr,
+        row3(q_pos), mpos3, mvalid3, row3(r_pos), row3(r_valid),
+        q, ppk, ppv, dpk, dpv, rk, rv,
+    )
+    return out[:, :S]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "layer", "scale", "softcap", "block_q", "block_r", "interpret",
+    ),
+)
+def paged_attention(
+    q: jax.Array,  # [B, S, NH, D] — S = 1 for plain decode steps
+    ppk: jax.Array,  # [L, Pp, pg, KVH, D] FULL prompt page pool (any dtype)
+    ppv: jax.Array,  # [L, Pp, pg, KVH, D]
+    dpk: jax.Array,  # [L, Pd, ch, KVH, D] FULL decode page pool
+    dpv: jax.Array,  # [L, Pd, ch, KVH, D]
+    mpos: jax.Array,  # [B, PS*ch] int32 — logical decode-tier positions
+    mvalid: jax.Array,  # [B, PS*ch] bool — logical decode-tier validity
+    rk: jax.Array,  # [B, R, KVH, D] chunk ring, batch-major (cache dtype)
+    rv: jax.Array,  # [B, R, KVH, D]
+    r_pos: jax.Array,  # [B, R]
+    r_valid: jax.Array,  # [B, R] — MUST be init-False before first append
+    q_pos: jax.Array,  # [B, S]
+    ptab: jax.Array,  # [B, NP] int32 — prompt page table (sentinel >= Pp)
+    dtab: jax.Array,  # [B, PS] int32 — decode page table (logical order)
+    true_len: jax.Array,  # [B] int32 — real prompt length per slot
+    *,
+    layer: int = 0,  # static layer index into the stacked pools
+    scale: float,
+    softcap: float | None = None,
+    window=None,  # int / traced int32 scalar; None or <= 0 disables
+    block_q: int = 128,
+    block_r: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused page-walk attention of a decode chunk against
+    (prompt pages ⊕ decode pages ⊕ ring). Returns [B, S, NH, D].
+
+    The pools ride in FULL, stacked over layers and pages, with the static
+    ``layer`` and the runtime page tables resolved inside the BlockSpec
+    index maps — no gathered copy ever exists. The ring must already
+    contain the chunk's own k/v rows (the model appends before attending)
+    and must have started all-invalid; see the module docstring for the
+    masking contract. GQA query head ``h`` reads KV head ``h // (NH //
+    KVH)``."""
+    return _paged_attention(
+        q, ppk, ppv, dpk, dpv, mpos, mvalid, rk, rv, r_pos, r_valid, q_pos,
+        ptab, dtab, true_len,
+        layer=layer, scale=scale, softcap=softcap, window=window,
+        block_q=block_q, block_r=block_r, interpret=interpret,
+    )
+
+
+def xla_paged_attention(
+    q, ppk, ppv, dpk, dpv, mpos, mvalid, rk, rv, r_pos, r_valid, q_pos,
+    ptab, dtab, true_len,
+    *, layer=0, scale, softcap=None, window=None,
+) -> jax.Array:
+    """Correctness oracle: gather the referenced pages exactly as the XLA
+    paged path does (``gather_prompt_pages`` / ``gather_decode_pages``),
+    concatenate (prompt ⊕ decode ⊕ ring) into one KV sequence, and run the
+    shared position-space XLA attention. Same operands as the kernel."""
+    from introspective_awareness_tpu.models.transformer import (
+        gather_decode_pages,
+        gather_prompt_pages,
+    )
+    from introspective_awareness_tpu.ops.attention import xla_attention
+
+    dt = q.dtype
+    B = q.shape[0]
+    pk, pv, smask, pos = gather_prompt_pages(ppk, ppv, ptab, true_len)
+    mk, mv = gather_decode_pages(dpk, dpv, dtab)  # [L, PS, ch, B, KVH, D]
+    L, PS, ch = mk.shape[:3]
+    mk_b = jnp.transpose(
+        mk[layer].reshape((PS * ch,) + mk.shape[3:]), (1, 0, 2, 3)
+    )  # [B, PS*ch, KVH, D]
+    mv_b = jnp.transpose(
+        mv[layer].reshape((PS * ch,) + mv.shape[3:]), (1, 0, 2, 3)
+    )
+    k = jnp.concatenate(
+        [pk[layer].astype(dt), mk_b.astype(dt), rk.astype(dt)], axis=1
+    )
+    v = jnp.concatenate(
+        [pv[layer].astype(dt), mv_b.astype(dt), rv.astype(dt)], axis=1
+    )
+    kv_pos = jnp.concatenate([pos, mpos, r_pos], axis=1)
+    kv_valid = jnp.concatenate(
+        [
+            smask.astype(jnp.int32), mvalid.astype(jnp.int32),
+            r_valid.astype(jnp.int32),
+        ],
+        axis=1,
+    )
+    return xla_attention(
+        q, k, v, q_pos, kv_pos, kv_valid,
+        scale=scale, softcap=softcap, window=window,
+    )

@@ -1,0 +1,208 @@
+"""Fused sample/argmax + stop/EOS/budget bookkeeping tail for decode steps.
+
+After the forward pass of a decode step, the XLA path runs a tail of small
+ops per step: ``argmax(logits + T*gumbel)``, the done-row pad mask, the
+emission counter, the EOS/budget done-latch, and the rolling stop-sequence
+tail match (``runtime.generate._chunk_core``). Each is tiny, but together
+they are a chain of kernel launches whose latency rides on every one of
+the chunk's ``ch`` steps. This kernel folds the whole tail into ONE
+launch: a blocked argmax sweep over the vocab (sequential grid, online
+max+index in VMEM scratch) whose final step also runs the bookkeeping and
+emits a packed ``[nxt | done | n_emitted | tail...]`` int32 row per slot.
+
+The PRNG stays in XLA: ``runtime.generate._slot_noise`` advances the
+per-slot threefry chain and hands the scaled gumbel noise in as an
+operand, so the sampled token stream is BIT-IDENTICAL to the XLA tail
+(same ``logits + T*g`` values, same first-occurrence argmax tie-break —
+the cross-block merge below only replaces the running winner on a STRICT
+improvement, preserving ``jnp.argmax`` semantics).
+
+Bookkeeping replicated exactly (order matters — see ``_chunk_core``):
+
+1. ``nxt = where(done, pad, argmax)``
+2. ``n_emitted += ~done``
+3. ``done |= isin(nxt, eos) | (n_emitted >= budget)``
+4. stop tails shift unconditionally; ``done |= stop_hit(stop, tail)``
+   (negative stop entries are wildcards).
+
+Two static kernel variants — with and without the stop operands — instead
+of zero-width padding: a padded stop row would be all-wildcards and match
+everything, and Mosaic rejects zero-width blocks. The speculative path
+keeps its XLA tail (acceptance clamping is a cross-position reduction that
+does not fit the per-step shape; see runtime.paged).
+
+Clamp-pad convention (ops/__init__.py): logits/noise are NOT padded to the
+block multiple — the last vocab block clamp-pads and a ``col < V`` lane
+mask kills the tail.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from introspective_awareness_tpu.parallel.compat import tpu_compiler_params
+
+_NEG_INF = -1e30
+
+
+def _tail_kernel(
+    pad_ref, done_ref, nem_ref, budget_ref, eos_ref, tail_ref, stop_ref,
+    x_ref, n_ref, o_ref, m_scr, i_scr,
+    *, vocab: int, block_v: int, use_stop: bool, n_stop: int,
+):
+    """One vocab-block grid step; the last step emits the packed row."""
+    v = pl.program_id(0)
+
+    @pl.when(v == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        i_scr[:] = jnp.zeros_like(i_scr)
+
+    x = x_ref[:, :].astype(jnp.float32) + n_ref[:, :].astype(jnp.float32)
+    col = v * block_v + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    x = jnp.where(col < vocab, x, _NEG_INF)
+    bm = jnp.max(x, axis=1, keepdims=True)  # [B, 1]
+    # First occurrence inside the block; strict > across blocks keeps the
+    # earliest block's winner — together: jnp.argmax's first-match rule.
+    bi = jnp.min(
+        jnp.where(x == bm, col, jnp.int32(2**30)), axis=1, keepdims=True
+    )
+    better = bm > m_scr[:, :]
+    i_scr[:, :] = jnp.where(better, bi, i_scr[:, :])
+    m_scr[:, :] = jnp.where(better, bm, m_scr[:, :])
+
+    @pl.when(v == pl.num_programs(0) - 1)
+    def _emit():
+        pad = pad_ref[0]
+        done = done_ref[:, :]  # [B, 1] int32 (0/1)
+        alive = 1 - done
+        nxt = jnp.where(done != 0, pad, i_scr[:, :])  # [B, 1]
+        nem = nem_ref[:, :] + alive
+        is_eos = jnp.any(nxt == eos_ref[0:1, :], axis=1, keepdims=True)
+        ndone = (
+            (done != 0) | is_eos | (nem >= budget_ref[:, :])
+        ).astype(jnp.int32)
+        o_ref[:, 0:1] = nxt
+        o_ref[:, 2:3] = nem
+        if use_stop:
+            tail = tail_ref[:, :]  # [B, Ls]
+            new_tail = jnp.concatenate([tail[:, 1:], nxt], axis=1)
+            hit = jnp.zeros_like(done) != 0
+            for j in range(n_stop):  # n_stop is small and static
+                row = stop_ref[j:j + 1, :]  # [1, Ls]
+                hit = hit | jnp.all(
+                    (row < 0) | (new_tail == row), axis=1, keepdims=True
+                )
+            ndone = ndone | hit.astype(jnp.int32)
+            o_ref[:, 3:] = new_tail
+        o_ref[:, 1:2] = ndone
+
+
+@functools.partial(jax.jit, static_argnames=("block_v", "interpret"))
+def fused_sample_tail(
+    logits: jax.Array,  # [B, V] f32 — the step's last-position logits
+    noise: jax.Array,  # [B, V] f32 — T * gumbel (zeros when greedy)
+    done: jax.Array,  # [B] bool
+    n_emitted: jax.Array,  # [B] int32
+    budget: jax.Array,  # [B] int32
+    tail: jax.Array,  # [B, Ls] int32 (Ls may be 0)
+    eos_ids: jax.Array,  # [E] int32
+    pad_id,  # int32 scalar
+    stop_seqs: jax.Array | None = None,  # [n_stop, Ls]; None = no matching
+    *,
+    block_v: int = 2048,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One-launch decode-step tail. Returns ``(nxt [B] int32, done [B]
+    bool, n_emitted [B] int32, tail [B, Ls] int32)`` with exactly the XLA
+    tail's semantics (module docstring)."""
+    B, V = logits.shape
+    Ls = tail.shape[1]
+    use_stop = stop_seqs is not None and stop_seqs.shape[0] > 0 and Ls > 0
+    block_v = min(block_v, ((V + 127) // 128) * 128)
+    n_blocks = (V + block_v - 1) // block_v
+
+    def col2(x):
+        return x.astype(jnp.int32)[:, None]
+
+    E = eos_ids.shape[0]
+    eos = (
+        eos_ids.astype(jnp.int32)[None, :] if E
+        # Zero-width blocks are illegal; -1 never matches a sampled token
+        # (argmax/pad ids are non-negative).
+        else jnp.full((1, 1), -1, jnp.int32)
+    )
+    pad_arr = jnp.asarray(pad_id, jnp.int32).reshape(1)
+    if use_stop:
+        tail_ops = (tail.astype(jnp.int32), stop_seqs.astype(jnp.int32))
+        n_stop = stop_seqs.shape[0]
+    else:
+        # Static no-stop variant: 1-wide placeholders keep the kernel
+        # signature uniform; the kernel never reads them (use_stop=False).
+        tail_ops = (
+            jnp.zeros((B, 1), jnp.int32), jnp.zeros((1, 1), jnp.int32),
+        )
+        n_stop = 0
+
+    out = pl.pallas_call(
+        functools.partial(
+            _tail_kernel, vocab=V, block_v=block_v, use_stop=use_stop,
+            n_stop=n_stop,
+        ),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # pad_id
+            pl.BlockSpec((B, 1), lambda v: (0, 0)),  # done
+            pl.BlockSpec((B, 1), lambda v: (0, 0)),  # n_emitted
+            pl.BlockSpec((B, 1), lambda v: (0, 0)),  # budget
+            pl.BlockSpec(eos.shape, lambda v: (0, 0)),  # eos table
+            pl.BlockSpec(tail_ops[0].shape, lambda v: (0, 0)),  # tail
+            pl.BlockSpec(tail_ops[1].shape, lambda v: (0, 0)),  # stop table
+            pl.BlockSpec((B, block_v), lambda v: (0, v)),  # logits
+            pl.BlockSpec((B, block_v), lambda v: (0, v)),  # noise
+        ],
+        out_specs=pl.BlockSpec((B, 3 + (Ls if use_stop else 0)),
+                               lambda v: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (B, 3 + (Ls if use_stop else 0)), jnp.int32
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((B, 1), jnp.float32),  # running max
+            pltpu.VMEM((B, 1), jnp.int32),  # running argmax
+        ],
+        compiler_params=tpu_compiler_params(
+            pltpu, dimension_semantics=("arbitrary",)
+        ),
+        interpret=interpret,
+    )(
+        pad_arr, col2(done), col2(n_emitted), col2(budget), eos,
+        tail_ops[0], tail_ops[1], logits, noise,
+    )
+    nxt = out[:, 0]
+    new_done = out[:, 1] != 0
+    new_nem = out[:, 2]
+    new_tail = out[:, 3:] if use_stop else tail
+    return nxt, new_done, new_nem, new_tail
+
+
+def xla_sample_tail(
+    logits, noise, done, n_emitted, budget, tail, eos_ids, pad_id,
+    stop_seqs=None,
+):
+    """Correctness oracle: the literal XLA tail from ``_chunk_core``."""
+    from introspective_awareness_tpu.runtime.generate import _stop_hit
+
+    alive = ~done
+    nxt = jnp.argmax(logits + noise, axis=-1).astype(jnp.int32)
+    nxt = jnp.where(done, pad_id, nxt)
+    n_emitted = n_emitted + alive.astype(jnp.int32)
+    done = done | jnp.isin(nxt, eos_ids) | (n_emitted >= budget)
+    if stop_seqs is not None and stop_seqs.shape[0] > 0 and tail.shape[1]:
+        tail = jnp.concatenate([tail[:, 1:], nxt[:, None]], axis=1)
+        done = done | _stop_hit(stop_seqs, tail)
+    return nxt, done, n_emitted, tail

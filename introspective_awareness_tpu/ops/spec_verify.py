@@ -1,0 +1,100 @@
+"""One-launch speculative verify against the paged KV cache.
+
+``runtime.generate._spec_core`` scores all k+1 speculative positions
+(``[prev, d1..dk]``) with a single full-depth forward; under
+``--decode-kernel xla`` that forward's attention re-reads a GATHERED copy
+of the slot's pages per layer. This module closes the PR 10 stretch goal:
+the whole verify window attends against (prompt pages ⊕ decode pages ⊕
+ring) in ONE ``ops.paged_attention`` kernel launch per layer — the q-block
+grid dimension carries all k+1 query positions, so the page walk, the
+online softmax, and the within-window causality all happen inside the same
+launch that the plain decode step uses.
+
+Verify-window semantics fall out of the shared position-space masking
+(nothing verify-specific is needed in the kernel):
+
+- query s of the window sits at position ``base + s``; ring slot
+  ``rlen0 + j`` (the verify append rewrites slots ``[rlen0, rlen0 + k]``
+  at every layer before any read) sits at position ``base + j`` — so
+  ``kp <= qp`` is exactly "draft j visible to queries s >= j", the
+  causal-within-chunk rule of the XLA ring mask.
+- draft forwards (``layer_limit``) only wrote layers < draft_layers; the
+  verify append overwrites those slots for EVERY layer before attending,
+  so no partial-depth scratch is ever read at full depth.
+- holes from previous rounds (rejected drafts) are ``rvalid``-False and
+  contribute exact ``+0.0``; the init-False ring contract
+  (``runtime.paged._assemble_pallas``) covers never-written slots.
+
+The kernel itself is S-generic (``ops.paged_attention._paged_attention``);
+this wrapper pins the S = k+1 call shape to its own jit entry so the
+verify launch is a distinct compiled unit, and pairs it with the matching
+XLA oracle for the test matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from introspective_awareness_tpu.ops.paged_attention import (
+    _paged_attention,
+    xla_paged_attention,
+)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "layer", "scale", "softcap", "block_q", "block_r", "interpret",
+    ),
+)
+def spec_verify_attention(
+    q: jax.Array,  # [B, k+1, NH, D] — the whole verify window at once
+    ppk: jax.Array,  # [L, Pp, pg, KVH, D] prompt page pool
+    ppv: jax.Array,
+    dpk: jax.Array,  # [L, Pd, ch, KVH, D] decode page pool
+    dpv: jax.Array,
+    mpos: jax.Array,  # [B, PS*ch] int32
+    mvalid: jax.Array,  # [B, PS*ch] bool
+    rk: jax.Array,  # [B, R, KVH, D] chunk ring (holds the verify window)
+    rv: jax.Array,
+    r_pos: jax.Array,  # [B, R]
+    r_valid: jax.Array,  # [B, R]
+    q_pos: jax.Array,  # [B, k+1]
+    ptab: jax.Array,  # [B, NP] int32
+    dtab: jax.Array,  # [B, PS] int32
+    true_len: jax.Array,  # [B] int32
+    *,
+    layer: int = 0,
+    scale: float,
+    softcap: float | None = None,
+    window=None,
+    block_q: int = 128,
+    block_r: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Score all k+1 verify positions against the paged cache in one
+    launch. Returns [B, k+1, NH, D]; operands as
+    :func:`ops.paged_attention.paged_attention`."""
+    return _paged_attention(
+        q, ppk, ppv, dpk, dpv, mpos, mvalid, rk, rv, r_pos, r_valid, q_pos,
+        ptab, dtab, true_len,
+        layer=layer, scale=scale, softcap=softcap, window=window,
+        block_q=block_q, block_r=block_r, interpret=interpret,
+    )
+
+
+def xla_spec_verify_attention(
+    q, ppk, ppv, dpk, dpv, mpos, mvalid, rk, rv, r_pos, r_valid, q_pos,
+    ptab, dtab, true_len,
+    *, layer=0, scale, softcap=None, window=None,
+) -> jax.Array:
+    """Correctness oracle — the gathered-concat XLA reference applied to
+    the verify window (identical to ``xla_paged_attention``; re-exported
+    under the verify name so the test matrix reads symmetrically)."""
+    return xla_paged_attention(
+        q, ppk, ppv, dpk, dpv, mpos, mvalid, rk, rv, r_pos, r_valid, q_pos,
+        ptab, dtab, true_len,
+        layer=layer, scale=scale, softcap=softcap, window=window,
+    )

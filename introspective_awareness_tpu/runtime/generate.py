@@ -624,19 +624,29 @@ class SchedSpec(NamedTuple):
     stop_seqs: Optional[jax.Array] = None  # [n_stop, Ls], -1 = wildcard
 
 
-def _slot_sample(logits: jax.Array, keydata: jax.Array, temperature):
-    """Per-slot sampling: same argmax(logits + T*gumbel) formula as
-    ``_sample_and_decode`` but with an independent PRNG stream per slot, so
-    a trial's samples don't depend on which slots its queue neighbours
-    landed in. Returns (tokens [B], advanced keydata [B, 2])."""
+def _slot_noise(logits: jax.Array, keydata: jax.Array, temperature):
+    """The PRNG half of :func:`_slot_sample`: advance each slot's threefry
+    chain and return the scaled gumbel noise ``T * g`` (exact zeros when
+    greedy). Factored out so the fused Pallas tail
+    (``ops.sample_tail.fused_sample_tail``) consumes bit-identical noise —
+    the key chain advances exactly as ``_slot_sample`` does. Returns
+    (noise [B, V], advanced keydata [B, 2])."""
     keys = jax.random.wrap_key_data(keydata)
     nk = jax.vmap(lambda k: jax.random.split(k))(keys)  # [B, 2] keys
     g = jax.vmap(lambda k, l: jax.random.gumbel(k, l.shape, l.dtype))(
         nk[:, 0], logits
     )
-    temp = jnp.maximum(temperature, 0.0)
-    tok = jnp.argmax(logits + temp * g, axis=-1).astype(jnp.int32)
-    return tok, jax.random.key_data(nk[:, 1])
+    return jnp.maximum(temperature, 0.0) * g, jax.random.key_data(nk[:, 1])
+
+
+def _slot_sample(logits: jax.Array, keydata: jax.Array, temperature):
+    """Per-slot sampling: same argmax(logits + T*gumbel) formula as
+    ``_sample_and_decode`` but with an independent PRNG stream per slot, so
+    a trial's samples don't depend on which slots its queue neighbours
+    landed in. Returns (tokens [B], advanced keydata [B, 2])."""
+    noise, keydata = _slot_noise(logits, keydata, temperature)
+    tok = jnp.argmax(logits + noise, axis=-1).astype(jnp.int32)
+    return tok, keydata
 
 
 def _stop_hit(stop: jax.Array, tail: jax.Array) -> jax.Array:
@@ -1051,13 +1061,22 @@ def _chunk_core(
     spec: SchedSpec,
     *,
     ch: int,
+    pools=None,
+    fused_tail: bool = False,
 ) -> tuple:
     """The ``ch``-step masked decode loop shared by the classic
     (``scheduler_decode_chunk``) and paged (``runtime.paged``) chunk
     executables. Returns ``(cache, state, tokens)`` with the chunk ring
     UN-merged — each wrapper folds it into its own merged storage (the
     classic merged tier vs. the decode page pool). One body, two cache
-    layouts: that is the paged bit-identity argument in code form."""
+    layouts: that is the paged bit-identity argument in code form.
+
+    ``pools`` (a ``models.transformer.PagedPools``) routes each step's
+    attention through the Pallas page-walk kernel; ``fused_tail`` swaps
+    the per-step sample/EOS/budget/stop tail for the one-launch
+    ``ops.sample_tail`` kernel (tokens bit-identical either way — the
+    PRNG chain stays in ``_slot_noise``). Both are trace-time switches of
+    the ``--decode-kernel pallas`` executables (runtime.paged)."""
     B = state.prev.shape[0]
     steer_decode = SteerSpec(
         state.steer_layer,
@@ -1076,15 +1095,31 @@ def _chunk_core(
         out = forward(
             params, cfg, prev[:, None], alive.astype(jnp.int32)[:, None],
             step_pos, cache=cache, steer=steer_decode, use_cache=True,
-            logits_mode="last",
+            logits_mode="last", pools=pools,
         )
-        nxt, keydata = _slot_sample(out.logits, keydata, spec.temperature)
-        nxt = jnp.where(done, spec.pad_id, nxt)
-        n_emitted = n_emitted + alive.astype(jnp.int32)
-        done = done | jnp.isin(nxt, spec.eos_ids) | (n_emitted >= state.budget)
-        if use_stop:
-            tail = jnp.concatenate([tail[:, 1:], nxt[:, None]], axis=1)
-            done = done | _stop_hit(stop, tail)
+        if fused_tail:
+            from introspective_awareness_tpu.ops.sample_tail import (
+                fused_sample_tail,
+            )
+
+            noise, keydata = _slot_noise(
+                out.logits, keydata, spec.temperature
+            )
+            nxt, done, n_emitted, tail = fused_sample_tail(
+                out.logits, noise, done, n_emitted, state.budget, tail,
+                spec.eos_ids, spec.pad_id, stop if use_stop else None,
+                interpret=jax.default_backend() == "cpu",
+            )
+        else:
+            nxt, keydata = _slot_sample(out.logits, keydata, spec.temperature)
+            nxt = jnp.where(done, spec.pad_id, nxt)
+            n_emitted = n_emitted + alive.astype(jnp.int32)
+            done = done | jnp.isin(nxt, spec.eos_ids) | (
+                n_emitted >= state.budget
+            )
+            if use_stop:
+                tail = jnp.concatenate([tail[:, 1:], nxt[:, None]], axis=1)
+                done = done | _stop_hit(stop, tail)
         tokens = lax.dynamic_update_slice(tokens, nxt[:, None], (0, i))
         return out.cache, nxt, done, n_emitted, keydata, tokens, tail
 
@@ -1142,9 +1177,13 @@ def _spec_core(
     rounds: int,
     k: int,
     draft_layers: int,
+    pools=None,
 ) -> tuple:
     """The speculative round loop shared by ``scheduler_decode_chunk_
-    speculate`` and the paged variant (``runtime.paged``). Returns
+    speculate`` and the paged variant (``runtime.paged``). ``pools``
+    routes draft steps and the k+1-wide verify through the Pallas
+    page-walk kernels (``ops.paged_attention`` / ``ops.spec_verify`` —
+    the verify window scores in ONE launch per layer). Returns
     ``(cache, state, tokens, wcur, acc_total, drf_total)`` with the ring
     UN-merged (holes already invalidated via ``rvalid``); each wrapper
     compacts it into its own merged storage.
@@ -1213,7 +1252,7 @@ def _spec_core(
             out = forward(
                 params, cfg, d_prev[:, None], am1, (base_pos + j)[:, None],
                 cache=dcache, steer=steer_decode, use_cache=True,
-                logits_mode="last", layer_limit=draft_layers,
+                logits_mode="last", layer_limit=draft_layers, pools=pools,
             )
             dcache = out.cache
             d, keydata = _slot_sample(out.logits, keydata, spec.temperature)
@@ -1232,7 +1271,7 @@ def _spec_core(
         out_v = forward(
             params, cfg, ids_v, jnp.broadcast_to(am1, (B, k + 1)), pos_v,
             cache=vcache, steer=steer_decode, use_cache=True,
-            logits_mode="all",
+            logits_mode="all", pools=pools,
         )
         vlogits = out_v.logits  # [B, k+1, V]
         cache = out_v.cache

@@ -36,6 +36,7 @@ from jax import lax
 from introspective_awareness_tpu.models.config import ModelConfig
 from introspective_awareness_tpu.models.transformer import (
     KVCache,
+    PagedPools,
     gather_decode_pages,
     gather_prompt_pages,
     pool_fold_chunk,
@@ -80,6 +81,52 @@ def _assemble(
         mk=mk, mv=mv, mpos=mpos, mvalid=mvalid,
         mlen=jnp.int32(mvalid.shape[1]),
     )
+
+
+def _assemble_pallas(
+    ppk, ppv, dpk, dpv, mpos, mvalid,
+    state: SlotState, ptab, dtab, ring_len: int,
+) -> tuple[KVCache, PagedPools]:
+    """The ``--decode-kernel pallas`` counterpart of :func:`_assemble`:
+    NO gather ever happens. The returned cache carries ZERO-WIDTH slot and
+    merged tiers (the chunk core's mask plumbing traces over empty
+    buffers for free) plus the real chunk ring; the pools bundle rides
+    beside it and ``forward`` hands it to ``ops.paged_attention``, which
+    walks the page tables inside the kernel.
+
+    The ring inits ``rvalid`` FALSE for BOTH the plain and speculative
+    variants — the position-space kernel has no ``rlen`` operand, so
+    unwritten slots must be invalid outright. (The XLA speculative path
+    inits True and leans on its ``ridx < rlen`` gate; appends then write
+    the real per-row validity, and the spec hole-invalidation ANDs into
+    whatever is there, so False-init is correct for it too.)"""
+    B = state.prev.shape[0]
+    L = ppk.shape[0]
+    ch = dpk.shape[2]
+    kvh_kd = ppk.shape[3:]
+    kvh_vd = ppv.shape[3:]
+    cache = KVCache(
+        k=jnp.zeros((L, B, 0) + kvh_kd, ppk.dtype),
+        v=jnp.zeros((L, B, 0) + kvh_vd, ppv.dtype),
+        slot_mask=jnp.zeros((B, 0), jnp.bool_),
+        positions=jnp.zeros((B, 0), jnp.int32),
+        length=jnp.int32(0),
+        rk=jnp.zeros((L, ring_len, B) + kvh_kd, ppk.dtype),
+        rv=jnp.zeros((L, ring_len, B) + kvh_vd, ppv.dtype),
+        rpos=jnp.zeros((B, ring_len), jnp.int32),
+        rvalid=jnp.zeros((B, ring_len), jnp.bool_),
+        rlen=jnp.int32(0),
+        mk=jnp.zeros((L, 0, ch, B) + kvh_kd, dpk.dtype),
+        mv=jnp.zeros((L, 0, ch, B) + kvh_vd, dpv.dtype),
+        mpos=jnp.zeros((B, 0), jnp.int32),
+        mvalid=jnp.zeros((B, 0), jnp.bool_),
+        mlen=jnp.int32(0),
+    )
+    pools = PagedPools(
+        ppk=ppk, ppv=ppv, dpk=dpk, dpv=dpv, ptab=ptab, dtab=dtab,
+        true_len=state.true_len, mpos=mpos, mvalid=mvalid,
+    )
+    return cache, pools
 
 
 @partial(
@@ -247,12 +294,110 @@ def paged_decode_chunk_speculate(
     return dpk, dpv, mpos, mvalid, state, tokens, flags
 
 
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "ch"),
+    donate_argnames=("dpk", "dpv", "mpos", "mvalid", "state"),
+)
+def paged_decode_chunk_pallas(
+    params: dict,
+    cfg: ModelConfig,
+    ppk: jax.Array,
+    ppv: jax.Array,
+    dpk: jax.Array,
+    dpv: jax.Array,
+    mpos: jax.Array,
+    mvalid: jax.Array,
+    state: SlotState,
+    spec: SchedSpec,
+    ptab: jax.Array,
+    dtab: jax.Array,
+    page: jax.Array,
+    *,
+    ch: int,
+) -> tuple:
+    """``paged_decode_chunk`` on the Pallas kernel tier
+    (``--decode-kernel pallas``): no page gather — each step's attention
+    walks the page tables inside ``ops.paged_attention`` and the
+    sample/EOS/budget/stop tail runs as the one-launch
+    ``ops.sample_tail`` kernel. Same operands, flags contract, and fold
+    as the XLA twin; tokens are greedily TOKEN-identical to it (the
+    online softmax reorders the reduction, so logits agree to float
+    tolerance, not bitwise — tests/test_paged_attention_kernel.py)."""
+    cache, pools = _assemble_pallas(
+        ppk, ppv, dpk, dpv, mpos, mvalid, state, ptab, dtab, ring_len=ch,
+    )
+    cache = lax.optimization_barrier(cache)
+    cache, state, tokens = _chunk_core(
+        params, cfg, cache, state, spec, ch=ch, pools=pools, fused_tail=True,
+    )
+    dpk, dpv, mpos, mvalid = pool_fold_chunk(
+        dpk, dpv, mpos, mvalid, cache, dtab, page
+    )
+    flags = jnp.concatenate([state.done.astype(jnp.int32), state.n_emitted])
+    return dpk, dpv, mpos, mvalid, state, tokens, flags
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "rounds", "k", "draft_layers"),
+    donate_argnames=("dpk", "dpv", "mpos", "mvalid", "state"),
+)
+def paged_decode_chunk_speculate_pallas(
+    params: dict,
+    cfg: ModelConfig,
+    ppk: jax.Array,
+    ppv: jax.Array,
+    dpk: jax.Array,
+    dpv: jax.Array,
+    mpos: jax.Array,
+    mvalid: jax.Array,
+    state: SlotState,
+    spec: SchedSpec,
+    ptab: jax.Array,
+    dtab: jax.Array,
+    *,
+    rounds: int,
+    k: int,
+    draft_layers: int,
+) -> tuple:
+    """``paged_decode_chunk_speculate`` on the Pallas kernel tier: drafts
+    run the page-walk kernel per step and the k+1 verify window scores in
+    ONE ``ops.spec_verify`` launch per layer. The ring inits all-invalid
+    (see ``_assemble_pallas`` — the kernel's position-space masking needs
+    it); the sample tail stays XLA here (acceptance clamping is a
+    cross-position reduction, not a per-step tail). Same ``[3B + 2]``
+    flags contract as the XLA twin."""
+    W = rounds * (k + 1)
+    cache, pools = _assemble_pallas(
+        ppk, ppv, dpk, dpv, mpos, mvalid, state, ptab, dtab, ring_len=W,
+    )
+    cache = lax.optimization_barrier(cache)
+    cache, state, tokens, wcur, acc_total, drf_total = _spec_core(
+        params, cfg, cache, state, spec,
+        rounds=rounds, k=k, draft_layers=draft_layers, pools=pools,
+    )
+    dpk, dpv, mpos, mvalid = pool_fold_chunk_compact(
+        dpk, dpv, mpos, mvalid, cache, dtab
+    )
+    flags = jnp.concatenate([
+        state.done.astype(jnp.int32), state.n_emitted, wcur,
+        jnp.stack([acc_total, drf_total]),
+    ])
+    return dpk, dpv, mpos, mvalid, state, tokens, flags
+
+
 # Stable executable names for the device-measurement plane (see
-# runtime.generate.EXECUTABLES for the contract).
+# runtime.generate.EXECUTABLES for the contract: add entries, don't
+# rename). The ``*_pallas`` entries are the ``--decode-kernel pallas``
+# tier; obs/cost.py and obs/roofline.py attribute them separately so a
+# bench A/B shows both tiers' achieved-vs-peak rows side by side.
 PAGED_EXECUTABLES = {
     "paged_admit": paged_admit,
     "paged_decode_chunk": paged_decode_chunk,
     "paged_decode_chunk_speculate": paged_decode_chunk_speculate,
+    "paged_decode_chunk_pallas": paged_decode_chunk_pallas,
+    "paged_decode_chunk_speculate_pallas": paged_decode_chunk_speculate_pallas,
 }
 
 __all__ = [
@@ -260,4 +405,6 @@ __all__ = [
     "paged_admit",
     "paged_decode_chunk",
     "paged_decode_chunk_speculate",
+    "paged_decode_chunk_pallas",
+    "paged_decode_chunk_speculate_pallas",
 ]

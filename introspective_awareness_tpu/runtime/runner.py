@@ -79,6 +79,7 @@ class ModelRunner:
         kv_paged: str = "auto",
         kv_page_size: int = 16,
         kv_pool_pages: Optional[int] = None,
+        decode_kernel: str = "xla",
     ):
         self.params = params
         self.cfg = cfg
@@ -122,6 +123,30 @@ class ModelRunner:
         self.kv_paged = kv_paged
         self.kv_page_size = int(kv_page_size)
         self.kv_pool_pages = kv_pool_pages
+        # Decode-kernel tier for the paged scheduled path: "xla" keeps the
+        # gather-then-attend reference executables; "pallas" swaps in the
+        # fused page-walk attention kernels (ops.paged_attention /
+        # ops.spec_verify + fused sample tail). Greedy token streams are
+        # identical across tiers (tests/test_paged_attention_kernel.py);
+        # pallas runs interpret-mode on CPU, Mosaic on TPU, and is
+        # MHA/GQA-only.
+        if decode_kernel not in ("xla", "pallas"):
+            raise ValueError(
+                "decode_kernel must be 'xla' or 'pallas', got "
+                f"{decode_kernel!r}"
+            )
+        if decode_kernel == "pallas":
+            if getattr(cfg, "is_mla", False):
+                raise ValueError(
+                    "decode_kernel='pallas' is MHA/GQA-only; MLA configs "
+                    "must use decode_kernel='xla'"
+                )
+            if jax.default_backend() not in ("tpu", "cpu"):
+                raise ValueError(
+                    "decode_kernel='pallas' needs a TPU backend (or CPU "
+                    f"interpret mode); got {jax.default_backend()!r}"
+                )
+        self.decode_kernel = decode_kernel
         self.last_autotune: Optional[dict] = None
         self._aot_cache: dict = {}
         # Device-measurement plane, batch path: a RooflineMeter attached
@@ -1266,6 +1291,7 @@ class ModelRunner:
                 trace=trace, roofline=roofline,
                 replica=str(getattr(self, "replica_label", "0")),
                 speculate_k=speculate_k, draft_layers=draft_layers,
+                decode_kernel=self.decode_kernel,
             )
             done = [r for r in results if r is not None]
             span.add_evals(len(done))

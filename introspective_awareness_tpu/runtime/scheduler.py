@@ -95,7 +95,9 @@ from introspective_awareness_tpu.runtime.generate import (
 from introspective_awareness_tpu.runtime.paged import (
     paged_admit,
     paged_decode_chunk,
+    paged_decode_chunk_pallas,
     paged_decode_chunk_speculate,
+    paged_decode_chunk_speculate_pallas,
 )
 from introspective_awareness_tpu.runtime.radix import PagePool, RadixTree
 
@@ -987,6 +989,7 @@ def run_scheduled_paged(
     token_cb: Optional[Callable[[int, np.ndarray], None]] = None,
     max_prompt_len: Optional[int] = None,
     roofline=None,
+    decode_kernel: str = "xla",
 ) -> tuple[list[np.ndarray], dict]:
     """``run_scheduled`` over the PAGED KV cache (``runtime.paged``).
 
@@ -1038,17 +1041,33 @@ def run_scheduled_paged(
     tokens the moment an event's flags land (refill first-token included,
     finalization-truncated, pad-free) — the serving plane's chunked HTTP
     streaming and TTFT/ITL histograms hang off it. Works in static mode
-    too (keyed by queue position)."""
+    too (keyed by queue position).
+
+    ``decode_kernel`` selects the decode-chunk executable tier: ``"xla"``
+    (default) runs the gather-then-attend reference; ``"pallas"`` runs the
+    fused page-walk attention kernels (``ops.paged_attention`` /
+    ``ops.spec_verify`` + the fused sample tail) — same signature, same
+    donation contract, greedy token streams identical (see README "Decode
+    kernels" for the numeric-tolerance policy). MHA/GQA only."""
     ledger = ledger if ledger is not None else NullLedger()
     B = slots
     N = len(trials)
     pg = int(page_size)
     if pg <= 0:
         raise ValueError(f"page_size must be positive, got {page_size}")
+    if decode_kernel not in ("xla", "pallas"):
+        raise ValueError(
+            f"decode_kernel must be 'xla' or 'pallas', got {decode_kernel!r}"
+        )
+    if decode_kernel == "pallas" and getattr(cfg, "is_mla", False):
+        raise ValueError(
+            "decode_kernel='pallas' is MHA/GQA-only (no MLA latent path)"
+        )
     if N == 0 and feed is None:
         return [], {"chunks": 0, "refills": 0, "mean_slot_occupancy": 0.0,
                     "padded_row_waste_steps": 0, "pipelined": bool(pipeline),
                     "staged": True, "interrupted": False, "paged": True,
+                    "decode_kernel": decode_kernel,
                     "page_size": pg, "speculate_k": int(speculate_k),
                     "draft_layers": int(draft_layers) if speculate_k else 0,
                     "share_hits": 0, "share_misses": 0,
@@ -1451,21 +1470,39 @@ def run_scheduled_paged(
         refills += 1
         return True
 
+    # Kernel-tier dispatch selection: the pallas executables share the XLA
+    # path's signature/donation contract exactly; the stable NAME changes
+    # with the tier so obs.roofline / obs.cost attribute them separately
+    # (both names are registered in runtime.paged.PAGED_EXECUTABLES).
+    if decode_kernel == "pallas":
+        spec_fn, spec_name = (
+            paged_decode_chunk_speculate_pallas,
+            "paged_decode_chunk_speculate_pallas",
+        )
+        plain_fn, plain_name = (
+            paged_decode_chunk_pallas, "paged_decode_chunk_pallas",
+        )
+    else:
+        spec_fn, spec_name = (
+            paged_decode_chunk_speculate, "paged_decode_chunk_speculate"
+        )
+        plain_fn, plain_name = paged_decode_chunk, "paged_decode_chunk"
+
     def _dispatch_chunk() -> None:
         nonlocal dpk, dpv, mpos, mvalid, state, g, d_seq
         ptab_j = jnp.asarray(ptab_h)
         if speculate_k:
             if roofline is not None:
                 roofline.capture_once(
-                    "paged_decode_chunk_speculate",
-                    paged_decode_chunk_speculate,
+                    spec_name,
+                    spec_fn,
                     params, cfg, ppk, ppv, dpk, dpv, mpos, mvalid, state,
                     spec, ptab_j, dtab_j,
                     rounds=rounds, k=speculate_k, draft_layers=draft_layers,
                 )
-                roofline.dispatched("paged_decode_chunk_speculate", "chunk")
+                roofline.dispatched(spec_name, "chunk")
             dpk, dpv, mpos, mvalid, state, toks, flags = (
-                paged_decode_chunk_speculate(
+                spec_fn(
                     params, cfg, ppk, ppv, dpk, dpv, mpos, mvalid, state,
                     spec, ptab_j, dtab_j,
                     rounds=rounds, k=speculate_k, draft_layers=draft_layers,
@@ -1475,12 +1512,12 @@ def run_scheduled_paged(
             page = jnp.int32(g % PS) if PS else jnp.int32(0)
             if roofline is not None:
                 roofline.capture_once(
-                    "paged_decode_chunk", paged_decode_chunk,
+                    plain_name, plain_fn,
                     params, cfg, ppk, ppv, dpk, dpv, mpos, mvalid, state,
                     spec, ptab_j, dtab_j, page, ch=ring_w,
                 )
-                roofline.dispatched("paged_decode_chunk", "chunk")
-            dpk, dpv, mpos, mvalid, state, toks, flags = paged_decode_chunk(
+                roofline.dispatched(plain_name, "chunk")
+            dpk, dpv, mpos, mvalid, state, toks, flags = plain_fn(
                 params, cfg, ppk, ppv, dpk, dpv, mpos, mvalid, state, spec,
                 ptab_j, dtab_j, page, ch=ring_w,
             )
@@ -1729,6 +1766,7 @@ def run_scheduled_paged(
         "staged": True,
         "interrupted": bool(interrupted),
         "paged": True,
+        "decode_kernel": decode_kernel,
         "page_size": pg,
         "speculate_k": int(speculate_k),
         "draft_layers": int(draft_layers) if speculate_k else 0,

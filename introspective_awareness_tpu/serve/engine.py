@@ -384,6 +384,7 @@ class ServeEngine(SchedulerFeed):
                     replica=self.replica,
                     trace=self.trace,
                     roofline=self.roofline,
+                    decode_kernel=getattr(r, "decode_kernel", "xla"),
                 )
             except BaseException as e:  # noqa: BLE001 — surfaced at close()
                 self._loop_error = e

@@ -21,6 +21,10 @@ Gated metrics include the sweep fabric's 2-replica aggregate throughput
 (``fabric.aggregate_evals_per_s``); rounds predating the bench "fabric"
 section are skipped for that metric, never failed, so the gate picks up
 the replica-scaling trajectory as soon as one BENCH round carries it.
+The Pallas decode-kernel tier rides the same pattern: rounds carrying
+the bench "paged_attn_kernel" section gate
+``paged_attn_kernel_decode_steps_per_s`` (the ``--decode-kernel pallas``
+leg's throughput) against its own history; older rounds skip.
 
 Examples:
     python scripts/perf_gate.py --current bench_out.json

@@ -179,6 +179,14 @@ def main() -> None:
                          "one (e.g. http://127.0.0.1:9100)")
     ap.add_argument("--profile-duration-ms", type=int, default=1000,
                     help="with --capture-xplane: capture window in ms")
+    ap.add_argument("--decode-kernel", default="xla",
+                    choices=["xla", "pallas"],
+                    help="paged decode executable tier to profile (affects "
+                         "the scheduled/paged path, e.g. --stage-breakdown "
+                         "queues that route paged, and any XPlane capture "
+                         "of it): gather-then-attend reference (xla) or the "
+                         "fused page-walk Pallas kernels (pallas); A/B two "
+                         "runs to compare op mixes")
     args = ap.parse_args()
 
     import jax
@@ -221,6 +229,7 @@ def main() -> None:
     runner = ModelRunner(
         params, cfg, tok, model_name="profile-1b", ledger=ledger,
         hbm_budget_frac=args.hbm_budget_frac or None,
+        decode_kernel=args.decode_kernel,
     )
 
     from bench import _build_workload

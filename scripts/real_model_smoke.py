@@ -193,6 +193,14 @@ def main(argv=None) -> int:
                          "reference xla attention vs the fused kernel on the "
                          "real backend (the Pallas kernels are otherwise "
                          "only oracle-checked in interpret mode on CPU)")
+    ap.add_argument("--decode-kernel", choices=["xla", "pallas"],
+                    default=None,
+                    help="Paged decode executable tier for the smoke sweep. "
+                         "pallas additionally runs a HARDWARE parity check "
+                         "mirroring --attn-impl's: the same cell greedily "
+                         "with --kv-paged on under the gather-then-attend "
+                         "xla executables vs the fused page-walk Pallas "
+                         "kernels on the real backend")
     args = ap.parse_args(argv)
     if args.parity:
         if args.model is None:
@@ -211,7 +219,8 @@ def main(argv=None) -> int:
     from introspective_awareness_tpu.cli.sweep import main as sweep_main
     from introspective_awareness_tpu.metrics import config_dir
 
-    def run_cell(out_dir: str, attn_impl=None, temperature=None):
+    def run_cell(out_dir: str, attn_impl=None, temperature=None,
+                 decode_kernel=None, kv_paged=None):
         """One smoke cell; returns (rc, responses) from its results.json."""
         cell_argv = [
             "--models", str(ckpt),
@@ -228,6 +237,10 @@ def main(argv=None) -> int:
             cell_argv += ["--attn-impl", attn_impl]
         if temperature is not None:
             cell_argv += ["--temperature", str(temperature)]
+        if decode_kernel is not None:
+            cell_argv += ["--decode-kernel", decode_kernel]
+        if kv_paged is not None:
+            cell_argv += ["--kv-paged", kv_paged]
         rc = sweep_main(cell_argv)
         if rc != 0:
             return rc, []
@@ -277,7 +290,49 @@ def main(argv=None) -> int:
         print(f"attention parity check passed ({args.attn_impl})")
         return 0
 
-    rc, responses = run_cell(args.output_dir, attn_impl=args.attn_impl)
+    if args.decode_kernel == "pallas":
+        # Decode-kernel hardware parity, mirroring the --attn-impl mode:
+        # same cell, greedy, --kv-paged on (so the scheduled queue routes
+        # through the paged executables the flag selects between), xla
+        # gather-then-attend reference vs the fused page-walk Pallas
+        # kernels. Greedy token streams are identical by contract
+        # (tests/test_paged_attention_kernel.py pins it in interpret mode);
+        # on hardware a handful of near-tied-logit flips is tolerated, a
+        # broken kernel diverges everywhere.
+        print("decode-kernel parity check: xla vs pallas (greedy, paged)")
+        rc, ref = run_cell(f"{args.output_dir}/dk_xla", temperature=0.0,
+                           decode_kernel="xla", kv_paged="on")
+        if rc != 0:
+            print(f"reference (xla) sweep failed (rc={rc})")
+            return rc
+        rc, fused = run_cell(f"{args.output_dir}/dk_pallas", temperature=0.0,
+                             decode_kernel="pallas", kv_paged="on")
+        if rc != 0:
+            print(f"fused (pallas) sweep failed (rc={rc})")
+            return rc
+        if len(ref) != len(fused):
+            print(f"PARITY FAILED: {len(ref)} xla rows vs "
+                  f"{len(fused)} pallas rows")
+            return 1
+        same = sum(a == b for a, b in zip(ref, fused))
+        frac = same / max(1, len(ref))
+        print(f"identical responses: {same}/{len(ref)} ({frac:.0%})")
+        for i, (a, b) in enumerate(zip(ref, fused)):
+            if a != b:
+                print(f"  row {i} diverged:\n    xla:    {a[:100]!r}"
+                      f"\n    pallas: {b[:100]!r}")
+        ok, problems = coherence_report(fused)
+        if frac < 0.5 or not ok:
+            print(f"DECODE-KERNEL PARITY CHECK FAILED "
+                  f"(identical={frac:.0%}, coherent={ok}):")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+        print("decode-kernel parity check passed (pallas)")
+        return 0
+
+    rc, responses = run_cell(args.output_dir, attn_impl=args.attn_impl,
+                             decode_kernel=args.decode_kernel)
     if rc != 0:
         print(f"sweep failed (rc={rc})")
         return rc

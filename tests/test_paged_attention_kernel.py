@@ -1,0 +1,246 @@
+"""Pallas paged decode-kernel tier (--decode-kernel pallas) vs the XLA
+gather-then-attend reference.
+
+Two layers of checks, mirroring the ops/ test convention:
+
+- Kernel-unit oracle lanes: ``ops.paged_attention`` /
+  ``ops.spec_verify`` / ``ops.sample_tail`` against their ``xla_*``
+  oracles on randomized paged operands. Attention parity is NUMERIC with
+  a pinned tolerance (online softmax reduces in page order, the concat
+  oracle in one pass — bitwise equality across reduction orders is not a
+  meaningful target; README "Decode kernels" documents the policy). The
+  fused sample tail is integer bookkeeping and must match EXACTLY.
+- End-to-end greedy TOKEN identity: ``run_scheduled_paged`` under
+  ``decode_kernel="pallas"`` must reproduce the ``"xla"`` tier's token
+  streams byte-for-byte across page sizes {8, 16, 64} x slots {2, 4} x
+  (plain, speculative k=3). Steer on/off rides inside every queue: the
+  shared ``_queues`` workload mixes steered trials with strength-0 rows
+  (every third trial), so both paths are exercised in each run.
+
+On CPU the kernels run in interpret mode. Interpret-mode e2e runs are
+expensive (~40-80s each), so tier-1 keeps fast anchors only — the plain
+full page-size sweep plus one speculative page size at slots=2 — and the
+rest of the matrix (speculative page sweep, slots=4) is ``slow``-marked;
+the CI ``kernel-interpret`` lane runs the whole file WITHOUT the slow
+filter, so the full matrix still gates every merge. The TPU lanes repeat
+the A/B under a real Mosaic compile; they too require exact identity
+because tiny-config logit gaps are wide.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.models import (
+    ByteTokenizer,
+    init_params,
+    tiny_config,
+)
+from introspective_awareness_tpu.ops.paged_attention import (
+    paged_attention,
+    xla_paged_attention,
+)
+from introspective_awareness_tpu.ops.sample_tail import (
+    fused_sample_tail,
+    xla_sample_tail,
+)
+from introspective_awareness_tpu.ops.spec_verify import (
+    spec_verify_attention,
+    xla_spec_verify_attention,
+)
+from introspective_awareness_tpu.runtime.scheduler import run_scheduled_paged
+
+from test_paged_kv import _queues
+
+# Pinned numeric tolerance for kernel-vs-oracle attention parity (f32
+# accumulation both sides; the bound covers reduction-order drift only).
+ATOL = 2e-5
+
+INTERPRET = jax.default_backend() == "cpu"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _rand_paged_operands(rng, *, B, S, pg, NP, PS, ch, R, KVH, NH, D,
+                         Pp_extra=2, Pd_extra=3, L=2, layer=1):
+    """Randomized but invariant-respecting paged decode operands.
+
+    Per slot: ``true_len`` prompt tokens across a random page walk
+    (sentinel rows clamp), a partially filled merged decode tier at
+    logical positions ``true_len + i``, a ring chunk above that, and the
+    queries at the top — the exact coordinate layout the scheduler
+    maintains. Parity holds for ANY metadata (both paths apply the same
+    masks); realistic metadata makes the lanes read like the runtime.
+    """
+    Pp = NP * B + Pp_extra  # sentinel id == Pp, clamped in both paths
+    Pd = PS * B + Pd_extra
+    f = jnp.float32
+    ppk = jnp.asarray(rng.standard_normal((L, Pp, pg, KVH, D)), f)
+    ppv = jnp.asarray(rng.standard_normal((L, Pp, pg, KVH, D)), f)
+    dpk = jnp.asarray(rng.standard_normal((L, Pd, ch, KVH, D)), f)
+    dpv = jnp.asarray(rng.standard_normal((L, Pd, ch, KVH, D)), f)
+    rk = jnp.asarray(rng.standard_normal((B, R, KVH, D)), f)
+    rv = jnp.asarray(rng.standard_normal((B, R, KVH, D)), f)
+    q = jnp.asarray(rng.standard_normal((B, S, NH, D)), f)
+
+    true_len = rng.integers(1, NP * pg + 1, size=B)
+    perm = rng.permutation(Pp - Pp_extra)
+    ptab = np.full((B, NP), Pp, np.int32)
+    for b in range(B):
+        used = -(-int(true_len[b]) // pg)
+        ptab[b, :used] = perm[b * NP:b * NP + used]
+    dtab = rng.permutation(Pd - Pd_extra)[:B * PS].reshape(B, PS)
+
+    n_dec = rng.integers(0, PS * ch + 1, size=B)
+    pos_grid = np.arange(PS * ch)[None, :]
+    mpos = (true_len[:, None] + pos_grid).astype(np.int32)
+    mvalid = pos_grid < n_dec[:, None]
+    r_len = rng.integers(0, R + 1, size=B)
+    r_grid = np.arange(R)[None, :]
+    r_pos = (true_len[:, None] + n_dec[:, None] + r_grid).astype(np.int32)
+    r_valid = r_grid < r_len[:, None]
+    q_pos = (
+        true_len[:, None] + n_dec[:, None] + r_len[:, None]
+        + np.arange(S)[None, :]
+    ).astype(np.int32)
+    return dict(
+        q=q, ppk=ppk, ppv=ppv, dpk=dpk, dpv=dpv,
+        mpos=jnp.asarray(mpos), mvalid=jnp.asarray(mvalid),
+        rk=rk, rv=rv,
+        r_pos=jnp.asarray(r_pos), r_valid=jnp.asarray(r_valid),
+        q_pos=jnp.asarray(q_pos),
+        ptab=jnp.asarray(ptab), dtab=jnp.asarray(dtab),
+        true_len=jnp.asarray(true_len.astype(np.int32)),
+    ), layer
+
+
+@pytest.mark.parametrize("pg", [8, 16, 64])
+@pytest.mark.parametrize("S,window,softcap", [
+    (1, None, None),   # plain decode step
+    (1, 24, 30.0),     # sliding window + Gemma softcap
+    (4, None, None),   # speculative verify window (k=3)
+    (4, 24, None),
+])
+def test_kernel_matches_oracle(pg, S, window, softcap):
+    """Numeric parity on randomized operands across the page-size matrix,
+    GQA heads, sentinel page-table rows, and empty tiers (slots with
+    n_dec=0 / r_len=0 land in the draw)."""
+    rng = np.random.default_rng(pg * 100 + S)
+    ops, layer = _rand_paged_operands(
+        rng, B=3, S=S, pg=pg, NP=3, PS=2, ch=6, R=8, KVH=2, NH=4, D=16,
+    )
+    fn = paged_attention if S == 1 else spec_verify_attention
+    ref_fn = xla_paged_attention if S == 1 else xla_spec_verify_attention
+    got = fn(**ops, layer=layer, scale=0.25, softcap=softcap,
+             window=window, interpret=INTERPRET)
+    ref = ref_fn(**ops, layer=layer, scale=0.25, softcap=softcap,
+                 window=window)
+    err = float(jnp.max(jnp.abs(got - ref)))
+    assert err < ATOL, f"pg={pg} S={S}: max |err| {err} exceeds {ATOL}"
+
+
+@pytest.mark.parametrize("vocab", [100, 257, 4096])
+@pytest.mark.parametrize("with_stop", [False, True])
+def test_sample_tail_matches_oracle(vocab, with_stop):
+    """Integer bookkeeping must match the XLA tail EXACTLY — including
+    the argmax first-occurrence tie-break (duplicated maxima are forced
+    into the draw) and wildcard stop rows."""
+    rng = np.random.default_rng(vocab + int(with_stop))
+    B = 5
+    logits = rng.standard_normal((B, vocab)).astype(np.float32)
+    logits[0, 3] = logits[0, 7] = logits[0].max() + 1.0  # forced tie
+    noise = rng.standard_normal((B, vocab)).astype(np.float32) * 0.5
+    noise[1] = 0.0  # a greedy row
+    done = jnp.asarray([False, True, False, False, True])
+    n_emitted = jnp.asarray(rng.integers(0, 5, B), jnp.int32)
+    budget = jnp.asarray(rng.integers(1, 6, B), jnp.int32)
+    eos_ids = jnp.asarray([2, 9], jnp.int32)
+    if with_stop:
+        tail = jnp.asarray(rng.integers(-2, vocab, (B, 3)), jnp.int32)
+        stop = jnp.asarray(
+            [[-1, -1, 3], [5, 5, 5]], jnp.int32)  # wildcard + literal
+    else:
+        tail = jnp.zeros((B, 0), jnp.int32)
+        stop = None
+    args = (jnp.asarray(logits), jnp.asarray(noise), done, n_emitted,
+            budget, tail, eos_ids, 0, stop)
+    got = fused_sample_tail(*args, interpret=INTERPRET)
+    ref = xla_sample_tail(*args)
+    for name, g, r in zip(("nxt", "done", "n_emitted", "tail"), got, ref):
+        assert np.array_equal(np.asarray(g), np.asarray(r)), (
+            f"{name} diverged (vocab={vocab}, stop={with_stop}): "
+            f"{np.asarray(g)} vs {np.asarray(r)}"
+        )
+
+
+def _ab_identity(cfg, params, slots, speculate_k, page_sizes, temp=0.0):
+    _, _, paged = _queues(cfg)
+    kw = dict(
+        slots=slots, max_new_tokens=12, eos_ids=ByteTokenizer().eos_ids,
+        pad_id=ByteTokenizer().pad_id, seed=0, speculate_k=speculate_k,
+        draft_layers=2 if speculate_k else 0, temperature=temp,
+    )
+    for pg in page_sizes:
+        ref, _ = run_scheduled_paged(
+            params, cfg, paged, page_size=pg, decode_kernel="xla", **kw)
+        got, stats = run_scheduled_paged(
+            params, cfg, paged, page_size=pg, decode_kernel="pallas", **kw)
+        assert stats["decode_kernel"] == "pallas"
+        for i, (a, b) in enumerate(zip(ref, got)):
+            assert np.array_equal(a, b), (
+                f"trial {i} diverged (pg={pg}, slots={slots}, "
+                f"k={speculate_k}, temp={temp}): "
+                f"{a.tolist()} vs {b.tolist()}"
+            )
+
+
+@pytest.mark.parametrize("speculate_k,page_sizes", [
+    (0, (8, 16, 64)),  # plain decode: full page-size sweep
+    (3, (16,)),        # speculative anchor; full sweep in the slow lane
+])
+def test_pallas_decode_token_identity(setup, speculate_k, page_sizes):
+    """Greedy end-to-end fast anchors (slots=2): the pallas tier must
+    reproduce the xla tier's token streams byte-for-byte. The queue mixes
+    steered and strength-0 trials, so the steer-add path is exercised
+    both on and off in every run."""
+    cfg, params = setup
+    _ab_identity(cfg, params, 2, speculate_k, page_sizes)
+
+
+@pytest.mark.slow  # interpret-mode e2e; CI kernel-interpret lane runs these
+@pytest.mark.parametrize("slots,speculate_k,page_sizes", [
+    (2, 3, (8, 64)),        # completes the speculative page-size sweep
+    (4, 0, (8, 16, 64)),    # wide-slot plain
+    (4, 3, (8, 16, 64)),    # wide-slot speculative
+])
+def test_pallas_decode_token_identity_full(setup, slots, speculate_k,
+                                           page_sizes):
+    """Remainder of the pg {8,16,64} x slots {2,4} x (plain, k=3) matrix;
+    same assertion as the fast anchors."""
+    cfg, params = setup
+    _ab_identity(cfg, params, slots, speculate_k, page_sizes)
+
+
+def test_pallas_decode_sampled_identity(setup):
+    """Sampled decoding too: the fused tail receives the SAME noise from
+    the XLA-side threefry chain (ops.sample_tail docstring), so even
+    temperature>0 streams are identical across tiers."""
+    cfg, params = setup
+    _ab_identity(cfg, params, 2, 0, (16,), temp=0.9)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("speculate_k", [0, 3])
+def test_pallas_decode_token_identity_tpu(setup, speculate_k):
+    """Hardware lane: the same A/B on a real TPU (Mosaic compile instead
+    of interpret mode)."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs a TPU backend (Mosaic compile)")
+    cfg, params = setup
+    _ab_identity(cfg, params, 2, speculate_k, (16,))
