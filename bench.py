@@ -777,6 +777,136 @@ def _speculative_compare(runner, cfg, tok, slots, ledger, on_tpu) -> dict:
     return r
 
 
+def _adaptive_spec_compare(runner, cfg, tok, slots, ledger, on_tpu) -> dict:
+    """Adaptive speculation (``--speculate-k auto``) vs static k=3 linear
+    drafting on a strength/layer-varied queue.
+
+    The queue is built so NO single static config is right for all of it:
+    a quarter of the trials steer at layer 1 below every draft cut (the
+    drafter tracks the full model, acceptance ~1 — deep speculation pays)
+    and the rest steer ABOVE the cut at layer n-2 (the drafter is blind to
+    the injection, acceptance ~0 — every extra draft token is waste). The
+    controller starts optimistic, rides a deep bucket through the
+    high-acceptance phase, then drops to k=1 when the above-cut trials
+    refill the slots — per-cell EWMA decisions on pre-compiled bucket
+    executables (``spec_buckets_precompiled`` in the ledger), every one
+    journaled. Static k=3 pays 3 dead half-depth drafts per round through
+    the whole second phase, which is where the adaptive speedup comes
+    from; both legs must stay bit-identical to the non-speculative
+    scheduler. Both legs use the runner's default draft depth
+    (``n_layers // 2``); adaptive additionally tunes k and tree width.
+    """
+    import time as _time
+
+    from introspective_awareness_tpu.runtime.runner import ModelRunner
+
+    static_k, budget = 3, 192
+    if on_tpu:
+        params, sec_cfg = runner.params, cfg
+    else:
+        import dataclasses as _dc
+
+        import jax as _jax
+
+        from introspective_awareness_tpu.models.transformer import init_params
+
+        # Same 16-layer CPU-smoke model rationale as _speculative_compare:
+        # 4 layers cannot show a draft-depth effect worth adapting over.
+        sec_cfg = _dc.replace(cfg, n_layers=16)
+        init = _jax.jit(init_params, static_argnames=("cfg",))
+        params = init(sec_cfg, _jax.random.key(7))
+    sec_runner = ModelRunner(
+        params, sec_cfg, tok, model_name="bench-adaptive-spec",
+        seq_multiple=16, batch_multiple=slots, ledger=ledger,
+    )
+
+    N = 2 * slots
+    preamble = (
+        "I am an interpretability researcher studying transformer-based "
+        "language models. I can inject thoughts into your mind. "
+    )
+    prompts = [
+        preamble + f"Trial {i}: do you detect an injected thought?"
+        for i in range(N)
+    ]
+    rng = np.random.default_rng(0)
+    vecs = [
+        rng.normal(size=sec_cfg.hidden_size).astype(np.float32) * 4.0
+        for _ in range(N)
+    ]
+    starts = [len(preamble) + 2] * N
+    # Strength-varied queue: high-acceptance cells first (below-cut), the
+    # above-cut majority refills behind them — a genuine regime shift the
+    # controller has to catch mid-run.
+    layers = [1] * (N // 4) + [sec_cfg.n_layers - 2] * (N - N // 4)
+    strengths = [128.0] * N
+
+    def run(k):
+        return sec_runner.generate_grid_scheduled(
+            prompts, layer_indices=layers, steering_vectors=vecs,
+            strengths=strengths, max_new_tokens=budget, temperature=0.0,
+            steering_start_positions=starts, seed=0, slots=slots,
+            speculate_k=k,
+        )
+
+    # Warm every leg: the auto leg's first run pre-compiles ALL bucket
+    # executables (scheduler-level), so the timed run never sees XLA
+    # whatever bucket walk its calibration takes.
+    run(0)
+    run(static_k)
+    run("auto")
+    t0 = _time.perf_counter()
+    base_out = run(0)
+    t_base = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    static_out = run(static_k)
+    t_static = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    auto_out = run("auto")
+    t_auto = _time.perf_counter() - t0
+    identical = auto_out == base_out and static_out == base_out
+    sc = sec_runner.last_spec_control or {}
+
+    steps = N * (budget - 1) / slots
+    from collections import Counter as _Counter
+
+    walk = _Counter(e["bucket"] for e in sc.get("journal", []))
+    r = {
+        "static_k": static_k,
+        "n_layers": sec_cfg.n_layers,
+        "queue_trials": N,
+        "slots": slots,
+        "budget": budget,
+        "buckets": sc.get("buckets"),
+        "baseline_time_s": round(t_base, 3),
+        "static_time_s": round(t_static, 3),
+        "adaptive_time_s": round(t_auto, 3),
+        "speedup": (
+            round(t_static / t_auto, 3) if t_auto > 0 else None
+        ),
+        "static_decode_steps_per_s": (
+            round(steps / t_static, 3) if t_static > 0 else None
+        ),
+        "adaptive_spec_decode_steps_per_s": (
+            round(steps / t_auto, 3) if t_auto > 0 else None
+        ),
+        "outputs_identical": identical,
+        "adaptation_events": sc.get("adaptations"),
+        "decisions": sc.get("decisions"),
+        "final_bucket": sc.get("final_bucket"),
+        "bucket_walk": dict(walk),
+        "cells": sc.get("cells"),
+        "spec_control": sc,
+    }
+    log(
+        f"  [adaptive_spec] {N} trials x {slots} slots, budget {budget}: "
+        f"static k={static_k} {t_static:.2f}s vs auto {t_auto:.2f}s -> "
+        f"{r['speedup']}x, identical={identical}, "
+        f"adaptations={r['adaptation_events']}, walk={dict(walk)}"
+    )
+    return r
+
+
 def _pipeline_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
     """Pipelined vs synchronous scheduler host loop on the same queue shape
     as ``_sched_compare`` (mixed budgets, 5 short : 1 long).
@@ -1793,6 +1923,14 @@ def main() -> None:
         ledger,
     )
 
+    # ---- adaptive k/width controller vs static k on a regime-shift queue ---
+    adsp = _gated(
+        "adaptive_spec",
+        lambda: _adaptive_spec_compare(runner, cfg, tok, batches[0], ledger,
+                                       on_tpu),
+        ledger,
+    )
+
     # ---- pipelined vs synchronous host loop + grading overlap --------------
     pipe = _gated(
         "pipeline",
@@ -2140,6 +2278,7 @@ def main() -> None:
         "paged_kv": paged,
         "paged_attn_kernel": pak,
         "speculative": spec,
+        "adaptive_spec": adsp,
         "pipeline": pipe,
         "staged_prefill": stg,
         "durability": dur,

@@ -25,6 +25,21 @@ DEFAULT_OUTPUT_DIR = "introspective-awareness"
 DEFAULT_MODEL = "llama_8b"
 
 
+def _speculate_k_arg(value: str):
+    """``--speculate-k`` accepts an int (static k; 0 disables) or "auto"
+    (online controller picks k / draft depth / tree width per chunk)."""
+    if str(value).strip().lower() == "auto":
+        return "auto"
+    try:
+        k = int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer or 'auto', got {value!r}")
+    if k < 0:
+        raise argparse.ArgumentTypeError("--speculate-k must be >= 0")
+    return k
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="introspective_awareness_tpu",
@@ -83,7 +98,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "bit-identical to unstaged; see the README "
                              "staged-admission section for lookahead / "
                              "suffix-bucket tuning.")
-    parser.add_argument("--speculate-k", type=int, default=0,
+    parser.add_argument("--speculate-k", type=_speculate_k_arg, default=0,
                         help="With --scheduler continuous: self-speculative "
                              "decode — an early-exit drafter (the model's "
                              "first --draft-layers layers + the shared LM "
@@ -94,7 +109,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "temperature>0 draws are distribution-identical "
                              "(rejection sampling on the same per-trial PRNG "
                              "streams, so resumed sweeps must keep the same "
-                             "speculation config). 0 disables.")
+                             "speculation config). 'auto' enables the online "
+                             "controller: per-cell acceptance EWMAs pick k, "
+                             "draft depth, and tree width per chunk from a "
+                             "small pre-compiled bucket set (no recompiles; "
+                             "every decision journaled in the manifest). "
+                             "0 disables.")
     parser.add_argument("--draft-layers", type=int, default=None,
                         help="Early-exit depth of the self-speculative "
                              "drafter (layers [0, D) of the SAME weights; "

@@ -21,6 +21,8 @@ import time
 from pathlib import Path
 from typing import Optional
 
+from introspective_awareness_tpu.cli.args import _speculate_k_arg
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -50,6 +52,15 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=[None, "8bit", "4bit"])
     p.add_argument("--attn-impl", default="xla")
     p.add_argument("--kv-cache-dtype", default="model")
+    p.add_argument("--speculate-k", type=_speculate_k_arg, default=0,
+                   help="self-speculative decode for the serving loop: an "
+                        "int k (static; 0 disables) or 'auto' — the online "
+                        "controller picks k / draft depth / tree width per "
+                        "chunk from live acceptance, biased per request "
+                        "priority (interactive -> deep/narrow, bulk -> "
+                        "wide trees)")
+    p.add_argument("--draft-layers", type=int, default=None,
+                   help="early-exit drafter depth; default n_layers // 2")
     p.add_argument("--max-wall-s", type=float, default=0.0,
                    help="self-terminate after this many seconds (tests)")
     p.add_argument("--trace", action="store_true",
@@ -123,6 +134,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         registry=registry,
         trace=trace,
         roofline=meter,
+        speculate_k=args.speculate_k,
+        draft_layers=args.draft_layers,
     )
     n_recovered = engine.recover()
     engine.start()

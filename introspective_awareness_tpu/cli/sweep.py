@@ -670,7 +670,10 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
 
     timings["scheduler"] = args.scheduler
     timings["staged_prefill"] = bool(args.staged_prefill)
-    timings["speculate_k"] = int(args.speculate_k)
+    timings["speculate_k"] = (
+        args.speculate_k if args.speculate_k == "auto"
+        else int(args.speculate_k)
+    )
     timings["draft_layers"] = (
         int(args.draft_layers) if args.speculate_k and args.draft_layers
         else None
@@ -956,6 +959,10 @@ def _write_manifest(
             getattr(runner, "kv_pool_pages", None),
         ],
         "decode_kernel": getattr(runner, "decode_kernel", None),
+        # Adaptive speculation (--speculate-k auto): the controller's full
+        # decision journal — per-chunk bucket choices with the per-cell
+        # acceptance EWMAs that drove them — plus per-bucket calibration.
+        "spec_control": getattr(runner, "last_spec_control", None),
         "judge": (
             None if judge is None else {
                 "backend": getattr(args, "judge_backend", None),
@@ -1062,6 +1069,15 @@ def main(argv: Optional[list[str]] = None) -> int:
             "error: --fabric-replicas requires --scheduler continuous (the "
             "fabric leases per-trial work; the batch scheduler has no "
             "per-trial granularity to partition or steal)"
+        )
+        return 2
+    if args.speculate_k and args.scheduler != "continuous":
+        print(
+            "error: --speculate-k requires --scheduler continuous (the "
+            "batch scheduler has no per-slot decode rounds to speculate "
+            "over; the adaptive controller additionally needs per-chunk "
+            "dispatch decisions only the continuous scheduler makes); "
+            "drop --speculate-k or add --scheduler continuous"
         )
         return 2
     if getattr(args, "fabric_coordinator", None):

@@ -203,7 +203,7 @@ class SweepFabric:
         seed: Optional[int] = None,
         slots: int = 8,
         staged=None,
-        speculate_k: int = 0,
+        speculate_k=0,  # int, or "auto" (adaptive controller; resolved in the runner)
         draft_layers: Optional[int] = None,
         result_cb=None,
         trial_ids: Optional[Sequence[int]] = None,

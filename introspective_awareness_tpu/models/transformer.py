@@ -957,6 +957,7 @@ def forward(
     h0: jax.Array | None = None,  # [B, S, H] residual input (skips embedding)
     layer_offset: jax.Array | int = 0,  # global index of params' first layer
     pools: PagedPools | None = None,  # paged decode via ops.paged_attention
+    tree_mask: jax.Array | None = None,  # [S, S] within-chunk visibility
     *,
     use_cache: bool = False,
     capture: bool = False,
@@ -989,6 +990,15 @@ def forward(
       model). Ring KV is written for layers < D only; the caller must
       overwrite those slots with a full verify pass (which rewrites every
       layer) before any full-depth forward reads them.
+    - ``tree_mask`` (decode-only, [S, S] bool): replaces the within-chunk
+      CAUSAL visibility with an explicit node-to-node mask — row s may
+      attend chunk node j iff ``tree_mask[s, j]``. The speculative TREE
+      verify rides here: same-depth sibling nodes share a rope position,
+      so causal-by-offset masking cannot separate them; the mask encodes
+      ancestor-or-self per root-to-leaf path. Must be lower-triangular-
+      compatible (node j's ancestors precede it in the window). The
+      default ``None`` keeps ``tril`` — bit-identical to the previous
+      behavior for every existing call shape.
     - ``sp_mesh``: a mesh whose ``seq`` axis is > 1 routes S > 1 attention
       through ring attention (ops/ring.py) — the chunk's Q/K/V shard over
       the sequence axis and K/V rotate over ICI, so long-context prefill and
@@ -1064,10 +1074,21 @@ def forward(
                 jnp.zeros((B, RR), jnp.bool_), attn_mask.astype(jnp.bool_),
                 (0, rlen),
             )
-            causal_ring = (
-                (ridx[None, None, :] - rlen) <= jnp.arange(S)[None, :, None]
+            # Within-chunk visibility over the ring window [rlen, rlen+S):
+            # causal (tril) by default, or the caller's tree_mask (tree
+            # verify — see the docstring). Scattering the [S, S] window
+            # mask to ring coordinates makes the two cases one code path;
+            # slots outside the window are gated off by chunk_tok anyway.
+            win_mask = (
+                jnp.tril(jnp.ones((S, S), jnp.bool_)) if tree_mask is None
+                else tree_mask.astype(jnp.bool_)
             )
-            allowed_ring = written | (chunk_tok[:, None, :] & causal_ring)
+            win_ring = lax.dynamic_update_slice(
+                jnp.zeros((S, RR), jnp.bool_), win_mask, (0, rlen)
+            )
+            allowed_ring = written | (
+                chunk_tok[:, None, :] & win_ring[None, :, :]
+            )
             new_rpos = lax.dynamic_update_slice(cache.rpos, positions, (0, rlen))
             new_rvalid = lax.dynamic_update_slice(
                 cache.rvalid, attn_mask.astype(jnp.bool_), (0, rlen)
@@ -1217,6 +1238,29 @@ def forward(
                     jnp.where(sliding, cfg.sliding_window, 0)
                     if cfg.sliding_window is not None else 0
                 )
+                # Tree verify on the kernel tier: ring slots inside the
+                # verify window carry their window index (r_tag) and each
+                # query its packed ancestor set (q_anc) — the kernel's
+                # ring-tile ancestor term then applies tree_mask exactly
+                # (same-position siblings are otherwise indistinguishable
+                # in position space). Packed int32 bits cap S at 31;
+                # _spec_core enforces it before choosing a tree bucket.
+                r_tag = q_anc = None
+                if tree_mask is not None:
+                    jwin = ridx[None, :] - rlen  # [1, RR]
+                    r_tag = jnp.broadcast_to(
+                        jnp.where(
+                            (jwin >= 0) & (jwin < S), jwin, -1
+                        ).astype(jnp.int32),
+                        (B, RR),
+                    )
+                    q_anc = jnp.broadcast_to(
+                        (
+                            win_mask.astype(jnp.int32)
+                            * (jnp.int32(1) << jnp.arange(S, dtype=jnp.int32))[None, :]
+                        ).sum(axis=1),
+                        (B, S),
+                    )
                 fn = paged_attention if S == 1 else spec_verify_attention
                 attn = fn(
                     q, pools.ppk, pools.ppv, pools.dpk, pools.dpv,
@@ -1224,6 +1268,7 @@ def forward(
                     jnp.swapaxes(rk, 0, 1), jnp.swapaxes(rv, 0, 1),
                     new_rpos, new_rvalid, positions,
                     pools.ptab, pools.dtab, pools.true_len,
+                    r_tag, q_anc,
                     layer=l,
                     scale=cfg.query_scale if cfg.query_scale is not None
                     else cfg.head_dim**-0.5,

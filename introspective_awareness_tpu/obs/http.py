@@ -33,6 +33,7 @@ from typing import Any, Callable, Optional
 
 from introspective_awareness_tpu.obs.registry import (
     MetricsRegistry,
+    bucket_quantile,
     default_registry,
 )
 
@@ -203,8 +204,27 @@ def _progress_doc(registry: MetricsRegistry,
     doc = progress.snapshot() if progress is not None else {}
     gauges: dict[str, float] = {}
     counters: dict[str, float] = {}
+    histograms: dict[str, dict[str, Any]] = {}
     for name, m in registry.snapshot()["metrics"].items():
         if m["type"] == "histogram":
+            # Histograms read as count / mean / p50 per series — enough
+            # for a glanceable /progress doc (e.g. per-cell speculative
+            # acceptance rates) without dumping full bucket ladders.
+            for row in m["series"]:
+                bounds = [float(b) for b in row["buckets"]
+                          if b != "+Inf"]
+                counts = ([row["buckets"][str(b)] for b in row["buckets"]
+                           if b != "+Inf"]
+                          + [row["buckets"].get("+Inf", 0)])
+                cnt = int(row["count"])
+                lab = ",".join(
+                    f"{k}={v}" for k, v in row["labels"].items())
+                key = f"{name}{{{lab}}}" if lab else name
+                histograms[key] = {
+                    "count": cnt,
+                    "mean": (round(row["sum"] / cnt, 6) if cnt else None),
+                    "p50": bucket_quantile(bounds, counts, 0.5),
+                }
             continue
         series = m["series"]
         if m["type"] == "counter":
@@ -229,6 +249,7 @@ def _progress_doc(registry: MetricsRegistry,
                 gauges[f"{name}{{{lab}}}"] = row["value"]
     doc["gauges"] = gauges
     doc["counters"] = counters
+    doc["histograms"] = histograms
     return doc
 
 

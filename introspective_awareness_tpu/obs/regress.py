@@ -69,6 +69,13 @@ def _spec_steps(doc: dict) -> Optional[float]:
     return spec.get("speculative_decode_steps_per_s")
 
 
+def _adaptive_spec_steps(doc: dict) -> Optional[float]:
+    sec = doc.get("adaptive_spec") or {}
+    if sec.get("skipped"):
+        return None
+    return sec.get("adaptive_spec_decode_steps_per_s")
+
+
 def _paged_evals(doc: dict) -> Optional[float]:
     paged = doc.get("paged_kv") or {}
     if paged.get("skipped"):
@@ -105,6 +112,13 @@ HEADLINES: tuple = (
     # History-tolerant like fabric: rounds predating the section simply
     # don't carry the metric, so the gate reports "skipped", never a fail.
     ("speculative_decode_steps_per_s", _spec_steps, True, 0.20, 0.0),
+    # Adaptive speculation (--speculate-k auto: per-cell controller + tree
+    # drafting) on the regime-shift queue from the bench's "adaptive_spec"
+    # section. The controller's bucket walk is calibration-driven so the
+    # rate carries a little more run-to-run noise than the static legs.
+    # History-tolerant: rounds predating the section skip, never fail.
+    ("adaptive_spec_decode_steps_per_s", _adaptive_spec_steps,
+     True, 0.25, 0.0),
     # Paged-KV scheduler throughput on the divergent-suffix A/B queue from
     # the bench's "paged_kv" section. Same history-tolerance as fabric /
     # speculative: rounds predating the section skip, never fail.
@@ -304,6 +318,9 @@ def inject_regression(history: list[tuple[Optional[dict], Any]],
                 "paged_attn_kernel_decode_steps_per_s"):
         cur["paged_attn_kernel"][
             "paged_attn_kernel_decode_steps_per_s"] *= factor
+    if isinstance(cur.get("adaptive_spec"), dict) and \
+            cur["adaptive_spec"].get("adaptive_spec_decode_steps_per_s"):
+        cur["adaptive_spec"]["adaptive_spec_decode_steps_per_s"] *= factor
     return cur
 
 

@@ -275,15 +275,23 @@ def gqa_masked_scores(
 
 def xla_attention(
     q, k, v, q_positions, kv_positions, kv_valid,
-    *, scale, softcap=None, window=None,
+    *, scale, softcap=None, window=None, extra_mask=None,
 ) -> jax.Array:
     """Reference implementation with identical position-space semantics —
-    the fallback path and the kernel's correctness oracle."""
+    the fallback path and the kernel's correctness oracle.
+
+    ``extra_mask`` ([B, S, T] bool, optional) is ANDed into the positional
+    mask — the tree-verify ancestor restriction rides here (same-depth
+    sibling nodes share a position, so position-space causality alone
+    cannot separate them)."""
     B, S, NH, D = q.shape
     s, allowed = gqa_masked_scores(
         q, k, q_positions, kv_positions, kv_valid,
         scale=scale, softcap=softcap, window=window,
     )
+    if extra_mask is not None:
+        allowed = allowed & extra_mask
+        s = jnp.where(extra_mask[:, None, None, :, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bkgst,btkd->bskgd", p, v.astype(p.dtype))
     # Match the kernel's all-masked-row behavior (zeros, not uniform attn).

@@ -76,6 +76,7 @@ def _paged_kernel(
     ptab_ref, dtab_ref, tl_ref, w_ref,
     # blocked operands
     qpos_ref, mpos_ref, mvalid_ref, rpos_ref, rvalid_ref,
+    qanc_ref, rtag_ref,
     q_ref, ppk_ref, ppv_ref, dpk_ref, dpv_ref, rk_ref, rv_ref,
     o_ref, m_scr, l_scr, acc_scr,
     *, scale: float, softcap: float | None, groups: int, page_size: int,
@@ -102,8 +103,10 @@ def _paged_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def update(kp, valid, get_k, get_v):
-        """Shared online-softmax update; ``get_k/get_v(h)`` yield [BK, D]."""
+    def update(kp, valid, get_k, get_v, extra=None):
+        """Shared online-softmax update; ``get_k/get_v(h)`` yield [BK, D].
+        ``extra`` ([BQ, BK] bool, ring tiles only) carries the tree-verify
+        ancestor mask on top of position-space causality."""
         has_valid = valid != 0
         kp_min = jnp.min(jnp.where(has_valid, kp, jnp.int32(2**30)))
         kp_max = jnp.max(jnp.where(has_valid, kp, jnp.int32(-(2**30))))
@@ -115,6 +118,8 @@ def _paged_kernel(
         def _update():
             allowed = (kp[None, :] <= qp[:, None]) & has_valid[None, :]
             allowed &= (window <= 0) | ((qp[:, None] - kp[None, :]) < window)
+            if extra is not None:
+                allowed &= extra
             # q-major row merge: row i of a head's dot is query i // G,
             # query-head-in-group i % G.
             allowed_g = jnp.repeat(allowed, G, axis=0)  # [BQ*G, BK]
@@ -175,9 +180,20 @@ def _paged_kernel(
 
     @pl.when(t >= n_prompt + n_dec)
     def _ring():
+        # Tree-verify ancestor mask: ring slots inside the verify window
+        # carry their window index in r_tag (-1 = not a window slot); a
+        # query may attend window slot j only if bit j of its packed
+        # ancestor word is set. Linear verify passes all -1 tags, which
+        # reduces this to the pure position rule.
+        rt = rtag_ref[0, 0, :]  # [BK]
+        qa = qanc_ref[0, 0, :]  # [BQ]
+        anc = (rt[None, :] < 0) | (
+            ((qa[:, None] >> jnp.clip(rt[None, :], 0, 31)) & 1) == 1
+        )
         update(
             rpos_ref[0, 0, :], rvalid_ref[0, 0, :],
             lambda h: rk_ref[0, :, h, :], lambda h: rv_ref[0, :, h, :],
+            extra=anc,
         )
 
     @pl.when(t == pl.num_programs(2) - 1)
@@ -195,7 +211,7 @@ def _round_up(x: int, m: int) -> int:
 
 def _paged_attention(
     q, ppk, ppv, dpk, dpv, mpos, mvalid, rk, rv, r_pos, r_valid, q_pos,
-    ptab, dtab, true_len,
+    ptab, dtab, true_len, r_tag=None, q_anc=None,
     *, layer, scale, softcap, window, block_q, block_r, interpret,
 ):
     """Shared implementation behind :func:`paged_attention` (S == 1 decode
@@ -217,6 +233,15 @@ def _paged_attention(
         f"mpos width {mpos.shape[1]} != PS*ch {PS * ch}"
     )
 
+    # Tree-verify operands default to the "no tree" encoding: every ring
+    # slot untagged (-1) and every query ancestor-free — the kernel's
+    # ancestor term is then identically True and the plain position rule
+    # governs, so the linear/plain call shapes are unchanged.
+    if r_tag is None:
+        r_tag = jnp.full((B, R), -1, jnp.int32)
+    if q_anc is None:
+        q_anc = jnp.zeros((B, S), jnp.int32)
+
     block_q = min(block_q, _round_up(S, 8))
     block_r = min(block_r, _round_up(R, 128))
     # Scoped-VMEM guard for the unrolled per-head f32 score tiles (the pool
@@ -230,6 +255,7 @@ def _paged_attention(
     if s_pad != S:
         q = jnp.pad(q, ((0, 0), (0, s_pad - S), (0, 0), (0, 0)))
         q_pos = jnp.pad(q_pos, ((0, 0), (0, s_pad - S)))
+        q_anc = jnp.pad(q_anc, ((0, 0), (0, s_pad - S)))
     # Clamp-pad convention (ops/__init__.py): only 1-D position/validity
     # operands are padded to block multiples; K/V pools and ring stay
     # untouched — out-of-range tails of their last block clamp-pad and the
@@ -237,6 +263,9 @@ def _paged_attention(
     if r_pad != R:
         r_pos = jnp.pad(r_pos, ((0, 0), (0, r_pad - R)))
         r_valid = jnp.pad(r_valid, ((0, 0), (0, r_pad - R)))
+        r_tag = jnp.pad(
+            r_tag, ((0, 0), (0, r_pad - R)), constant_values=-1
+        )
 
     n_ring = r_pad // block_r
     grid = (B, s_pad // block_q, NP + PS + n_ring)
@@ -286,6 +315,12 @@ def _paged_attention(
                 (1, 1, block_r), lambda b, s, t, *_: (b, 0, ring_ix(t))
             ),  # r_valid
             pl.BlockSpec(
+                (1, 1, block_q), lambda b, s, t, *_: (b, 0, s)
+            ),  # q_anc
+            pl.BlockSpec(
+                (1, 1, block_r), lambda b, s, t, *_: (b, 0, ring_ix(t))
+            ),  # r_tag
+            pl.BlockSpec(
                 (1, block_q, NH, D), lambda b, s, t, *_: (b, s, 0, 0)
             ),  # q
             pl.BlockSpec((1, 1, pg, KVH, D), pp_ix),  # ppk
@@ -324,6 +359,7 @@ def _paged_attention(
         ptab.astype(jnp.int32), dtab.astype(jnp.int32),
         true_len.astype(jnp.int32), window_arr,
         row3(q_pos), mpos3, mvalid3, row3(r_pos), row3(r_valid),
+        row3(q_anc), row3(r_tag),
         q, ppk, ppv, dpk, dpv, rk, rv,
     )
     return out[:, :S]
@@ -351,6 +387,8 @@ def paged_attention(
     ptab: jax.Array,  # [B, NP] int32 — prompt page table (sentinel >= Pp)
     dtab: jax.Array,  # [B, PS] int32 — decode page table (logical order)
     true_len: jax.Array,  # [B] int32 — real prompt length per slot
+    r_tag: jax.Array | None = None,  # [B, R] int32 verify-window index, -1 off
+    q_anc: jax.Array | None = None,  # [B, S] int32 packed ancestor bits
     *,
     layer: int = 0,  # static layer index into the stacked pools
     scale: float,
@@ -372,15 +410,29 @@ def paged_attention(
     KVH)``."""
     return _paged_attention(
         q, ppk, ppv, dpk, dpv, mpos, mvalid, rk, rv, r_pos, r_valid, q_pos,
-        ptab, dtab, true_len,
+        ptab, dtab, true_len, r_tag, q_anc,
         layer=layer, scale=scale, softcap=softcap, window=window,
         block_q=block_q, block_r=block_r, interpret=interpret,
     )
 
 
+def tree_extra_mask(r_tag, q_anc, prefix_width):
+    """[B, S, T] extra mask for the XLA oracle: all-True over the
+    ``prefix_width`` non-ring columns, the packed-ancestor rule over the
+    ring columns — the gathered-concat mirror of the kernel's ring-tile
+    ancestor term."""
+    B, R = r_tag.shape
+    S = q_anc.shape[1]
+    ring = (r_tag[:, None, :] < 0) | (
+        ((q_anc[:, :, None] >> jnp.clip(r_tag[:, None, :], 0, 31)) & 1) == 1
+    )  # [B, S, R]
+    head = jnp.ones((B, S, prefix_width), bool)
+    return jnp.concatenate([head, ring], axis=2)
+
+
 def xla_paged_attention(
     q, ppk, ppv, dpk, dpv, mpos, mvalid, rk, rv, r_pos, r_valid, q_pos,
-    ptab, dtab, true_len,
+    ptab, dtab, true_len, r_tag=None, q_anc=None,
     *, layer=0, scale, softcap=None, window=None,
 ) -> jax.Array:
     """Correctness oracle: gather the referenced pages exactly as the XLA
@@ -418,7 +470,12 @@ def xla_paged_attention(
         ],
         axis=1,
     )
+    extra = None
+    if r_tag is not None and q_anc is not None:
+        extra = tree_extra_mask(
+            r_tag, q_anc, int(kv_pos.shape[1]) - int(r_tag.shape[1])
+        )
     return xla_attention(
         q, k, v, q_pos, kv_pos, kv_valid,
-        scale=scale, softcap=softcap, window=window,
+        scale=scale, softcap=softcap, window=window, extra_mask=extra,
     )

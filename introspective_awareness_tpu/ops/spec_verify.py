@@ -65,6 +65,8 @@ def spec_verify_attention(
     ptab: jax.Array,  # [B, NP] int32
     dtab: jax.Array,  # [B, PS] int32
     true_len: jax.Array,  # [B] int32
+    r_tag: jax.Array | None = None,  # [B, R] verify-window index, -1 = off
+    q_anc: jax.Array | None = None,  # [B, S] packed ancestor bitmask
     *,
     layer: int = 0,
     scale: float,
@@ -74,12 +76,20 @@ def spec_verify_attention(
     block_r: int = 128,
     interpret: bool = False,
 ) -> jax.Array:
-    """Score all k+1 verify positions against the paged cache in one
-    launch. Returns [B, k+1, NH, D]; operands as
-    :func:`ops.paged_attention.paged_attention`."""
+    """Score all verify positions against the paged cache in one launch.
+    Returns [B, S, NH, D]; operands as
+    :func:`ops.paged_attention.paged_attention`.
+
+    Tree verify (``S = 1 + width*k`` nodes, same-depth siblings sharing a
+    position) passes ``r_tag`` (each ring slot's verify-window index, -1
+    outside the window) and ``q_anc`` (per query, bit j set iff window
+    node j is an ancestor-or-self): a query attends a tagged slot only
+    when its ancestor bit is set, which restricts same-position siblings
+    to their own root-to-leaf path. Packing caps the window at 32 nodes;
+    ``runtime.generate._spec_core`` enforces it."""
     return _paged_attention(
         q, ppk, ppv, dpk, dpv, mpos, mvalid, rk, rv, r_pos, r_valid, q_pos,
-        ptab, dtab, true_len,
+        ptab, dtab, true_len, r_tag, q_anc,
         layer=layer, scale=scale, softcap=softcap, window=window,
         block_q=block_q, block_r=block_r, interpret=interpret,
     )
@@ -87,7 +97,7 @@ def spec_verify_attention(
 
 def xla_spec_verify_attention(
     q, ppk, ppv, dpk, dpv, mpos, mvalid, rk, rv, r_pos, r_valid, q_pos,
-    ptab, dtab, true_len,
+    ptab, dtab, true_len, r_tag=None, q_anc=None,
     *, layer=0, scale, softcap=None, window=None,
 ) -> jax.Array:
     """Correctness oracle — the gathered-concat XLA reference applied to
@@ -95,6 +105,6 @@ def xla_spec_verify_attention(
     under the verify name so the test matrix reads symmetrically)."""
     return xla_paged_attention(
         q, ppk, ppv, dpk, dpv, mpos, mvalid, rk, rv, r_pos, r_valid, q_pos,
-        ptab, dtab, true_len,
+        ptab, dtab, true_len, r_tag, q_anc,
         layer=layer, scale=scale, softcap=softcap, window=window,
     )

@@ -36,7 +36,7 @@ def run_trial_pass(
     debug: bool = False,
     scheduler: str = "batch",
     staged: bool = False,
-    speculate_k: int = 0,
+    speculate_k=0,  # int, or "auto" (adaptive controller; resolved in the runner)
     draft_layers: Optional[int] = None,
     grade_pool=None,
     journal=None,
@@ -148,7 +148,7 @@ def run_grid_pass(
     seed: Optional[int] = None,
     scheduler: str = "batch",
     staged: bool = False,
-    speculate_k: int = 0,
+    speculate_k=0,  # int, or "auto" (adaptive controller; resolved in the runner)
     draft_layers: Optional[int] = None,
     grade_pool=None,
     journal=None,

@@ -22,6 +22,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from introspective_awareness_tpu.models.config import ModelConfig
@@ -122,6 +123,19 @@ def _spec_chunk_plan(max_new_tokens: int, k: int) -> tuple[int, int]:
     rounds = min(rounds, steps_total) if steps_total else 1
     n_chunks = -(-steps_total // rounds) if steps_total else 0
     return n_chunks, rounds
+
+
+def _spec_rounds(max_new_tokens: int, k: int, width: int = 1) -> int:
+    """Rounds per speculative chunk for ONE (k, width) bucket. A tree
+    round consumes a ``1 + width*k`` verify window of ring slots, so the
+    same keep-the-ring-near-RING_CHUNK rule as ``_spec_chunk_plan``
+    (which this reproduces exactly at ``width == 1``) gives each adaptive
+    bucket its own rounds count; the shared classic ring is sized to the
+    max bucket ``rounds * window`` via ``scheduler_init(spec_ring=...)``."""
+    steps_total = max_new_tokens - 1
+    win = 1 + width * k
+    rounds = max(1, RING_CHUNK // win)
+    return min(rounds, steps_total) if steps_total else 1
 
 
 def _spec_merged_pages(max_new_tokens: int, ring_len: int) -> int:
@@ -660,7 +674,7 @@ def _stop_hit(stop: jax.Array, tail: jax.Array) -> jax.Array:
     jax.jit,
     static_argnames=(
         "cfg", "slots", "suffix_len", "max_new_tokens", "stop_width",
-        "with_prefix", "speculate_k",
+        "with_prefix", "speculate_k", "spec_ring",
     ),
 )
 def scheduler_init(
@@ -674,6 +688,7 @@ def scheduler_init(
     stop_width: int = 0,  # Ls of the stop-seq table (0 = no stop matching)
     with_prefix: bool = False,  # also return the batch-1 prefix KV (staged)
     speculate_k: int = 0,  # > 0: size the ring/pages for speculative chunks
+    spec_ring: int = 0,  # override ring slots/chunk (adaptive bucket max)
 ) -> tuple:
     """Build the persistent slot cache + empty slot state.
 
@@ -693,8 +708,14 @@ def scheduler_init(
     dtype = params["embed"].dtype
     H = params["embed"].shape[1]
     if speculate_k:
-        n_chunks, rounds = _spec_chunk_plan(max_new_tokens, speculate_k)
-        ch = rounds * (speculate_k + 1)  # ring slots per chunk, incl. holes
+        if spec_ring:
+            # Adaptive buckets share this cache: size the ring for the
+            # WIDEST bucket (max over buckets of rounds_b * window_b);
+            # _spec_core is ring-width-agnostic at runtime.
+            ch = spec_ring
+        else:
+            _, rounds = _spec_chunk_plan(max_new_tokens, speculate_k)
+            ch = rounds * (speculate_k + 1)  # ring slots/chunk incl. holes
         # Compacting merge: pages hold emitted tokens, not chunk slots.
         pages = _spec_merged_pages(max_new_tokens, ch)
     else:
@@ -1177,16 +1198,40 @@ def _spec_core(
     rounds: int,
     k: int,
     draft_layers: int,
+    width: int = 1,
     pools=None,
 ) -> tuple:
     """The speculative round loop shared by ``scheduler_decode_chunk_
     speculate`` and the paged variant (``runtime.paged``). ``pools``
-    routes draft steps and the k+1-wide verify through the Pallas
-    page-walk kernels (``ops.paged_attention`` / ``ops.spec_verify`` —
-    the verify window scores in ONE launch per layer). Returns
-    ``(cache, state, tokens, wcur, acc_total, drf_total)`` with the ring
+    routes draft steps and the verify through the Pallas page-walk
+    kernels (``ops.paged_attention`` / ``ops.spec_verify`` — the verify
+    window scores in ONE launch per layer). Returns
+    ``(cache, state, tokens, wcur, acc_slot, lr_slot)`` with the ring
     UN-merged (holes already invalidated via ``rvalid``); each wrapper
-    compacts it into its own merged storage.
+    compacts it into its own merged storage. ``acc_slot``/``lr_slot``
+    are PER-SLOT accepted-draft and live-round totals — the host maps
+    slots to grid cells for the adaptive controller's per-cell EWMAs.
+
+    ``width > 1`` drafts a TOKEN TREE per slot instead of one chain: the
+    shared root forward's top-``width`` level-1 tokens each seed a chain
+    (chain 0's first token is the sampled/argmax token, so chain 0 IS
+    the linear chain), extended depth-wise by ``k - 1`` sequential
+    early-exit forwards each. Between chains the ring cursor rewinds to
+    ``rlen0 + 1`` and the previous chain's extension slots are
+    ``rvalid``-invalidated, so each chain drafts under exactly its own
+    prefix with zero model changes. ALL ``1 + width*k`` tree nodes are
+    then scored in ONE full-depth verify launch: the ``tree_mask``
+    operand of ``models.transformer.forward`` restricts each node to its
+    root-to-leaf ancestors (same-depth siblings share a rope position,
+    so position-space causality cannot separate them; the Pallas tier
+    packs the mask into per-query int32 ancestor words). Acceptance
+    takes the longest root-to-leaf matching path: chains' first tokens
+    are distinct, so at most one chain matches the verify argmax at the
+    root and greedy streams stay BIT-IDENTICAL to non-speculative
+    decode. At temperature > 0 rejection sampling runs on chain 0 only
+    (the linear chain) — distribution-identity is preserved and the
+    extra chains are dead weight, which is why the controller drops
+    ``width > 1`` buckets when sampling.
 
     Each round the first ``draft_layers`` layers + the real LM head propose
     k tokens sequentially (per-slot SteerSpec applies inside the truncated
@@ -1215,8 +1260,16 @@ def _spec_core(
 
     Tokens ``[B, rounds*(k+1)]`` are FRONT-PACKED per row; ``wcur`` holds
     each row's column count."""
+    assert width >= 1
     B = state.prev.shape[0]
     W = rounds * (k + 1)
+    S_v = 1 + width * k  # verify window: prev + all tree nodes
+    if width > 1 and pools is not None:
+        # Pallas tree verify packs the ancestor set into int32 bit words.
+        assert S_v <= 31, (
+            f"tree verify window {S_v} exceeds the 31-node packed-ancestor "
+            f"limit (width={width}, k={k})"
+        )
     steer_decode = SteerSpec(
         state.steer_layer,
         state.steer_strength,
@@ -1229,6 +1282,24 @@ def _spec_core(
     rows = jnp.arange(B)
     idx = jnp.arange(k + 1, dtype=jnp.int32)
 
+    # Static tree topology: node 0 = prev, node 1 + c*k + j = chain c's
+    # depth-(j+1) token (chain-major). depth[] gives each node's position
+    # offset; par[] each draft node's PARENT node (whose verify logits
+    # predict it); tmask the ancestor-or-self visibility.
+    depth_np = np.zeros(S_v, np.int32)
+    par_np = np.zeros((width, k), np.int32)
+    tmask_np = np.zeros((S_v, S_v), bool)
+    tmask_np[0, 0] = True
+    for c in range(width):
+        for j in range(k):
+            n = 1 + c * k + j
+            depth_np[n] = j + 1
+            par_np[c, j] = 0 if j == 0 else n - 1
+            tmask_np[n, 0] = True
+            for i in range(j + 1):
+                tmask_np[n, 1 + c * k + i] = True
+    tree_mask = jnp.asarray(tmask_np) if width > 1 else None
+
     def split_keys(keydata):
         keys = jax.random.wrap_key_data(keydata)
         nk = jax.vmap(lambda kk: jax.random.split(kk))(keys)
@@ -1236,54 +1307,106 @@ def _spec_core(
 
     def round_body(_, carry):
         (cache, prev, done, n_emitted, keydata, tokens, wcur, tail,
-         acc_total, drf_total) = carry
+         acc_slot, lr_slot) = carry
         alive = ~done
         am1 = alive.astype(jnp.int32)[:, None]
         base_pos = state.true_len + n_emitted - 1
         rlen0 = cache.rlen
+        ridx = jnp.arange(cache.rk.shape[1], dtype=jnp.int32)
 
-        # Draft: k sequential early-exit forwards. Their (partial-depth)
-        # ring writes land in the real ring as scratch — the verify pass
-        # below rewrites the same slots at full depth before any full-depth
-        # attention reads them.
-        drafts, dlogits = [], []
-        d_prev, dcache = prev, cache
-        for j in range(k):
-            out = forward(
-                params, cfg, d_prev[:, None], am1, (base_pos + j)[:, None],
-                cache=dcache, steer=steer_decode, use_cache=True,
-                logits_mode="last", layer_limit=draft_layers, pools=pools,
-            )
-            dcache = out.cache
-            d, keydata = _slot_sample(out.logits, keydata, spec.temperature)
-            d = jnp.where(done, spec.pad_id, d)
-            d_prev = d
-            drafts.append(d)
-            dlogits.append(out.logits)
-        drafts = jnp.stack(drafts, axis=1)  # [B, k]
-        dlogits = jnp.stack(dlogits, axis=1)  # [B, k, V]
-
-        # Verify: rewind the ring cursor and score [prev, d1..dk] in one
-        # full-depth forward (causal-within-chunk ring masking).
-        vcache = dcache._replace(rlen=rlen0)
-        ids_v = jnp.concatenate([prev[:, None], drafts], axis=1)
-        pos_v = base_pos[:, None] + idx[None, :]
-        out_v = forward(
-            params, cfg, ids_v, jnp.broadcast_to(am1, (B, k + 1)), pos_v,
-            cache=vcache, steer=steer_decode, use_cache=True,
-            logits_mode="all", pools=pools,
+        # Draft: one shared root forward (writes prev's ring KV, yields the
+        # level-1 logits), then width chains of k-1 sequential early-exit
+        # extensions each. Partial-depth ring writes land in the real ring
+        # as scratch — the verify pass below rewrites the whole window at
+        # full depth before any full-depth attention reads it.
+        out0 = forward(
+            params, cfg, prev[:, None], am1, base_pos[:, None],
+            cache=cache, steer=steer_decode, use_cache=True,
+            logits_mode="last", layer_limit=draft_layers, pools=pools,
         )
-        vlogits = out_v.logits  # [B, k+1, V]
+        dcache = out0.cache
+        logits0 = out0.logits  # [B, V]
+        t0, keydata = _slot_sample(logits0, keydata, spec.temperature)
+        if width > 1:
+            topw = jax.lax.top_k(logits0, width)[1].astype(jnp.int32)
+
+        chains, dlogits = [], [logits0]
+        for c in range(width):
+            if c == 0:
+                tok = t0
+            else:
+                # Rewind to just [prev] + invalidate the previous chain's
+                # extension slots, so this chain drafts under its OWN
+                # prefix only (exact per-chain conditioning).
+                jw = ridx[None, :] - rlen0
+                wipe = (jw >= 1) & (jw <= k - 1)
+                dcache = dcache._replace(
+                    rlen=rlen0 + 1, rvalid=dcache.rvalid & ~wipe
+                )
+                tok = topw[:, c]
+            tok = jnp.where(done, spec.pad_id, tok)
+            ctoks, d_prev = [tok], tok
+            for j in range(1, k):
+                out = forward(
+                    params, cfg, d_prev[:, None], am1,
+                    (base_pos + j)[:, None],
+                    cache=dcache, steer=steer_decode, use_cache=True,
+                    logits_mode="last", layer_limit=draft_layers,
+                    pools=pools,
+                )
+                dcache = out.cache
+                if c == 0:
+                    # Chain 0 is the linear chain: sampled, key-advancing —
+                    # its (draft logits, tokens) feed rejection sampling.
+                    d, keydata = _slot_sample(
+                        out.logits, keydata, spec.temperature
+                    )
+                    dlogits.append(out.logits)
+                else:
+                    d = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)
+                d = jnp.where(done, spec.pad_id, d)
+                ctoks.append(d)
+                d_prev = d
+            chains.append(jnp.stack(ctoks, axis=1))
+        chains = jnp.stack(chains, axis=1)  # [B, width, k]
+        dlogits = jnp.stack(dlogits, axis=1)  # [B, k, V] — chain 0 only
+
+        # Verify: rewind the ring cursor and score [prev ⊕ all tree nodes]
+        # in one full-depth forward. width == 1 passes tree_mask=None (the
+        # tril default) — the exact PR 10 linear trace.
+        vcache = dcache._replace(rlen=rlen0)
+        ids_v = jnp.concatenate(
+            [prev[:, None], chains.reshape(B, width * k)], axis=1
+        )
+        pos_v = base_pos[:, None] + jnp.asarray(depth_np)[None, :]
+        out_v = forward(
+            params, cfg, ids_v, jnp.broadcast_to(am1, (B, S_v)), pos_v,
+            cache=vcache, steer=steer_decode, use_cache=True,
+            logits_mode="all", pools=pools, tree_mask=tree_mask,
+        )
+        vlogits = out_v.logits  # [B, S_v, V]
         cache = out_v.cache
 
-        def greedy(vlogits, dlogits, drafts, keydata):
-            t = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, k+1]
-            match = drafts == t[:, :k]
-            a = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
-            corr = jnp.take_along_axis(t, a[:, None], axis=1)[:, 0]
-            return a, corr, keydata
+        def greedy(vlogits, dlogits, chains, keydata):
+            t = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, S_v]
+            tp = t[:, jnp.asarray(par_np.reshape(-1))].reshape(B, width, k)
+            match = chains == tp
+            a_c = jnp.cumprod(match.astype(jnp.int32), axis=2).sum(axis=2)
+            # First-max tie-break: chains' first tokens are distinct, so at
+            # most one chain matches t[:, 0] — ties only happen at a == 0,
+            # where every chain denotes the same (empty) path.
+            c_star = jnp.argmax(a_c, axis=1).astype(jnp.int32)
+            a = jnp.take_along_axis(a_c, c_star[:, None], axis=1)[:, 0]
+            node = jnp.where(a == 0, 0, 1 + c_star * k + a - 1)
+            corr = jnp.take_along_axis(t, node[:, None], axis=1)[:, 0]
+            return a, corr, keydata, c_star
 
-        def rejection(vlogits, dlogits, drafts, keydata):
+        def rejection(vlogits, dlogits, chains, keydata):
+            # Chain 0 occupies window nodes 1..k, so its verify rows are
+            # the contiguous [0, k] prefix — the PR 10 linear rejection
+            # verbatim; the other chains are greedy-only dead weight here.
+            vlogits = vlogits[:, : k + 1]
+            drafts = chains[:, 0, :]
             T = jnp.maximum(spec.temperature, 1e-6)
             p = jax.nn.softmax(vlogits / T, axis=-1)
             q = jax.nn.softmax(dlogits / T, axis=-1)
@@ -1313,12 +1436,18 @@ def _spec_core(
             corr = jnp.argmax(
                 jnp.log(jnp.maximum(dist, 1e-30)) + g, axis=-1
             ).astype(jnp.int32)
-            return a, corr, keydata
+            # temp > 0 always resolves on chain 0 (the sampled chain).
+            return a, corr, keydata, jnp.zeros((B,), jnp.int32)
 
-        a, corr, keydata = lax.cond(
+        a, corr, keydata, c_star = lax.cond(
             spec.temperature > 0, rejection, greedy,
-            vlogits, dlogits, drafts, keydata,
+            vlogits, dlogits, chains, keydata,
         )
+        # The accepted chain's tokens feed emission exactly like the PR 10
+        # linear drafts did.
+        drafts = jnp.take_along_axis(
+            chains, c_star[:, None, None], axis=1
+        )[:, 0]
 
         # Candidate emissions [d1..da, corr]; clamp at the FIRST terminal
         # token (EOS / stop-seq / budget) so the terminal token itself is
@@ -1365,36 +1494,43 @@ def _spec_core(
         )
         tokens = tokens.at[rows[:, None], col].set(cand, mode="drop")
         wcur = wcur + c_eff
-        acc_total = acc_total + (a * alive.astype(jnp.int32)).sum()
-        drf_total = drf_total + k * alive.astype(jnp.int32).sum()
+        acc_slot = acc_slot + a * alive.astype(jnp.int32)
+        lr_slot = lr_slot + alive.astype(jnp.int32)
 
-        # Accepted tokens only: invalidate the rejected tail of the verify
-        # window (slot 0 = prev, slots 1..a = accepted drafts; the
-        # correction token's KV lands next round as its slot 0). Holes are
-        # bit-neutral under the masked-softmax exact-zero property.
-        ridx = jnp.arange(cache.rk.shape[1], dtype=jnp.int32)
+        # Accepted path only: keep prev (window slot 0) and the winning
+        # chain's first ``a`` slots; every other window slot — rejected
+        # tail AND losing chains — goes rvalid-False (the correction
+        # token's KV lands next round as its slot 0). Holes are
+        # bit-neutral under the masked-softmax exact-zero property. At
+        # width == 1 this reduces to the PR 10 ``jwin <= a`` rule.
         jwin = ridx[None, :] - rlen0
-        keep = ~((jwin >= 0) & (jwin <= k)) | (jwin <= a[:, None])
+        in_win = (jwin >= 0) & (jwin < S_v)
+        cw = (jwin - 1) // k
+        dj = (jwin - 1) % k
+        keep_in = (jwin == 0) | (
+            (cw == c_star[:, None]) & (dj < a[:, None])
+        )
+        keep = ~in_win | keep_in
         cache = cache._replace(rvalid=cache.rvalid & keep)
         return (cache, prev, done, n_emitted, keydata, tokens, wcur, tail,
-                acc_total, drf_total)
+                acc_slot, lr_slot)
 
     carry = (
         cache, state.prev, state.done, state.n_emitted, state.keydata,
         tokens0, jnp.zeros((B,), jnp.int32), state.tail,
-        jnp.int32(0), jnp.int32(0),
+        jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
     )
     (cache, prev, done, n_emitted, keydata, tokens, wcur, tail,
-     acc_total, drf_total) = lax.fori_loop(0, rounds, round_body, carry)
+     acc_slot, lr_slot) = lax.fori_loop(0, rounds, round_body, carry)
     state = state._replace(
         prev=prev, done=done, n_emitted=n_emitted, keydata=keydata, tail=tail
     )
-    return cache, state, tokens, wcur, acc_total, drf_total
+    return cache, state, tokens, wcur, acc_slot, lr_slot
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "rounds", "k", "draft_layers"),
+    static_argnames=("cfg", "rounds", "k", "draft_layers", "width"),
     donate_argnames=("cache", "state"),
 )
 def scheduler_decode_chunk_speculate(
@@ -1408,18 +1544,25 @@ def scheduler_decode_chunk_speculate(
     rounds: int,
     k: int,
     draft_layers: int,
+    width: int = 1,
 ) -> tuple:
     """Self-speculative variant of ``scheduler_decode_chunk``: ``rounds``
-    rounds of (k early-exit drafts + one k+1-wide full verify) per chunk
-    (the round loop itself is ``_spec_core``, shared with the paged path).
+    rounds of (draft tree + one ``1 + width*k``-wide full verify) per
+    chunk (the round loop itself is ``_spec_core``, shared with the paged
+    path). ``width == 1`` is the PR 10 linear chain bit-for-bit.
 
-    Returns tokens ``[B, rounds*(k+1)]`` FRONT-PACKED per row (col count in
-    flags) and a ``[3B + 2]`` flags vector: ``[done | n_emitted |
-    emitted_this_chunk | accepted_total, drafted_total]`` — one host copy
-    per chunk, same as the non-speculative contract."""
-    cache, state, tokens, wcur, acc_total, drf_total = _spec_core(
+    Each (rounds, k, draft_layers, width) tuple is its own jit entry —
+    the adaptive controller switches between ALREADY-COMPILED bucket
+    executables at chunk granularity, never triggering a recompile.
+
+    Returns tokens ``[B, rounds*(k+1)]`` FRONT-PACKED per row (col count
+    in flags) and a ``[5B]`` flags vector: ``[done | n_emitted |
+    emitted_this_chunk | accepted_per_slot | live_rounds_per_slot]`` —
+    one host copy per chunk; the per-slot tails let the host attribute
+    acceptance to grid cells for the controller's EWMAs."""
+    cache, state, tokens, wcur, acc_slot, lr_slot = _spec_core(
         params, cfg, cache, state, spec,
-        rounds=rounds, k=k, draft_layers=draft_layers,
+        rounds=rounds, k=k, draft_layers=draft_layers, width=width,
     )
     if _use_merged(cfg):
         # Compacting merge: only the ACCEPTED ring slots land, at each
@@ -1431,7 +1574,7 @@ def scheduler_decode_chunk_speculate(
         cache = merge_chunk_compact(cache, cfg)
     flags = jnp.concatenate([
         state.done.astype(jnp.int32), state.n_emitted, wcur,
-        jnp.stack([acc_total, drf_total]),
+        acc_slot, lr_slot,
     ])
     return cache, state, tokens, flags
 

@@ -249,7 +249,7 @@ def paged_decode_chunk(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "rounds", "k", "draft_layers"),
+    static_argnames=("cfg", "rounds", "k", "draft_layers", "width"),
     donate_argnames=("dpk", "dpv", "mpos", "mvalid", "state"),
 )
 def paged_decode_chunk_speculate(
@@ -269,27 +269,30 @@ def paged_decode_chunk_speculate(
     rounds: int,
     k: int,
     draft_layers: int,
+    width: int = 1,
 ) -> tuple:
     """Speculative paged chunk: shared ``_spec_core`` over the gathered
     view, compacting fold (``pool_fold_chunk_compact`` — count-addressed,
-    so no ``page`` operand) into the decode pool. Same ``[3B + 2]`` flags
-    contract as ``scheduler_decode_chunk_speculate``."""
-    W = rounds * (k + 1)
+    so no ``page`` operand) into the decode pool. The ring is built FRESH
+    per call, so each (rounds, k, width) adaptive bucket gets its own
+    exactly-sized ring for free. Same ``[5B]`` flags contract as
+    ``scheduler_decode_chunk_speculate``."""
+    R = rounds * (1 + width * k)  # ring slots: one verify window per round
     cache = _assemble(
         ppk, ppv, dpk, dpv, mpos, mvalid, state, ptab, dtab,
-        ring_len=W, ring_valid=True,
+        ring_len=R, ring_valid=True,
     )
     cache = lax.optimization_barrier(cache)
-    cache, state, tokens, wcur, acc_total, drf_total = _spec_core(
+    cache, state, tokens, wcur, acc_slot, lr_slot = _spec_core(
         params, cfg, cache, state, spec,
-        rounds=rounds, k=k, draft_layers=draft_layers,
+        rounds=rounds, k=k, draft_layers=draft_layers, width=width,
     )
     dpk, dpv, mpos, mvalid = pool_fold_chunk_compact(
         dpk, dpv, mpos, mvalid, cache, dtab
     )
     flags = jnp.concatenate([
         state.done.astype(jnp.int32), state.n_emitted, wcur,
-        jnp.stack([acc_total, drf_total]),
+        acc_slot, lr_slot,
     ])
     return dpk, dpv, mpos, mvalid, state, tokens, flags
 
@@ -340,7 +343,7 @@ def paged_decode_chunk_pallas(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "rounds", "k", "draft_layers"),
+    static_argnames=("cfg", "rounds", "k", "draft_layers", "width"),
     donate_argnames=("dpk", "dpv", "mpos", "mvalid", "state"),
 )
 def paged_decode_chunk_speculate_pallas(
@@ -360,29 +363,33 @@ def paged_decode_chunk_speculate_pallas(
     rounds: int,
     k: int,
     draft_layers: int,
+    width: int = 1,
 ) -> tuple:
     """``paged_decode_chunk_speculate`` on the Pallas kernel tier: drafts
-    run the page-walk kernel per step and the k+1 verify window scores in
-    ONE ``ops.spec_verify`` launch per layer. The ring inits all-invalid
+    run the page-walk kernel per step and the ``1 + width*k`` verify
+    window scores in ONE ``ops.spec_verify`` launch per layer (tree
+    siblings disambiguated by the packed ancestor mask — see
+    ``ops.paged_attention.tree_extra_mask``). The ring inits all-invalid
     (see ``_assemble_pallas`` — the kernel's position-space masking needs
     it); the sample tail stays XLA here (acceptance clamping is a
-    cross-position reduction, not a per-step tail). Same ``[3B + 2]``
-    flags contract as the XLA twin."""
-    W = rounds * (k + 1)
+    cross-position reduction, not a per-step tail). Same ``[5B]`` flags
+    contract as the XLA twin."""
+    R = rounds * (1 + width * k)
     cache, pools = _assemble_pallas(
-        ppk, ppv, dpk, dpv, mpos, mvalid, state, ptab, dtab, ring_len=W,
+        ppk, ppv, dpk, dpv, mpos, mvalid, state, ptab, dtab, ring_len=R,
     )
     cache = lax.optimization_barrier(cache)
-    cache, state, tokens, wcur, acc_total, drf_total = _spec_core(
+    cache, state, tokens, wcur, acc_slot, lr_slot = _spec_core(
         params, cfg, cache, state, spec,
-        rounds=rounds, k=k, draft_layers=draft_layers, pools=pools,
+        rounds=rounds, k=k, draft_layers=draft_layers, width=width,
+        pools=pools,
     )
     dpk, dpv, mpos, mvalid = pool_fold_chunk_compact(
         dpk, dpv, mpos, mvalid, cache, dtab
     )
     flags = jnp.concatenate([
         state.done.astype(jnp.int32), state.n_emitted, wcur,
-        jnp.stack([acc_total, drf_total]),
+        acc_slot, lr_slot,
     ])
     return dpk, dpv, mpos, mvalid, state, tokens, flags
 

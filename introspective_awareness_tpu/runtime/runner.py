@@ -53,6 +53,14 @@ from introspective_awareness_tpu.runtime.scheduler import (
     run_scheduled,
     run_scheduled_paged,
 )
+from introspective_awareness_tpu.runtime.spec_control import (
+    AUTO_K_MAX,
+    SpecBucket,
+    SpecController,
+    default_buckets,
+    parse_speculate_k,
+    spec_cell_key,
+)
 
 
 class ModelRunner:
@@ -148,6 +156,10 @@ class ModelRunner:
                 )
         self.decode_kernel = decode_kernel
         self.last_autotune: Optional[dict] = None
+        # Adaptive-speculation controller snapshot from the most recent
+        # scheduled call (--speculate-k auto): decisions journal + per-cell
+        # EWMAs, folded into the sweep manifest.
+        self.last_spec_control: Optional[dict] = None
         self._aot_cache: dict = {}
         # Device-measurement plane, batch path: a RooflineMeter attached
         # here (late-bound, opt-in — pays one AOT compile per executable)
@@ -907,6 +919,7 @@ class ModelRunner:
         roofline=None,
         speculate_k: int = 0,
         draft_layers: Optional[int] = None,
+        spec_buckets: Optional[Sequence] = None,
         **kw,
     ) -> list[str]:
         """Continuous-batching counterpart of
@@ -1013,7 +1026,12 @@ class ModelRunner:
         # More slots than trials just decodes permanently-empty rows; clamp
         # (costs a shape bucket only when the whole queue is this small).
         slots = max(1, min(slots, N))
-        speculate_k = int(speculate_k)
+        spec_auto, speculate_k = parse_speculate_k(speculate_k)
+        if spec_auto:
+            # Adaptive: static k is the geometry anchor (max bucket k).
+            speculate_k = min(AUTO_K_MAX, max(1, max_new_tokens - 1))
+        spec_control = None
+        spec_cell_of = None
         if speculate_k:
             if draft_layers is None:
                 draft_layers = max(1, self.cfg.n_layers // 2)
@@ -1023,6 +1041,35 @@ class ModelRunner:
                     f"draft_layers={draft_layers} must be in "
                     f"(0, {self.cfg.n_layers}) when speculate_k > 0"
                 )
+            if spec_buckets is not None:
+                # Forced bucket set (tests / bench tree anchors): a
+                # single-bucket controller dispatches exactly that
+                # (k, draft_layers, width) every chunk. The static
+                # speculate_k stays the geometry anchor, so every
+                # bucket's k must fit under it.
+                buckets = tuple(
+                    b if isinstance(b, SpecBucket) else SpecBucket(*b)
+                    for b in spec_buckets
+                )
+                if any(b.k > speculate_k for b in buckets):
+                    raise ValueError(
+                        f"spec_buckets {buckets} exceed the static "
+                        f"geometry anchor speculate_k={speculate_k}"
+                    )
+                spec_control = SpecController(
+                    buckets, n_layers=self.cfg.n_layers,
+                    temperature=float(temperature),
+                )
+                spec_cell_of = spec_cell_key
+            elif spec_auto:
+                spec_control = SpecController(
+                    default_buckets(
+                        speculate_k, draft_layers, self.cfg.n_layers
+                    ),
+                    n_layers=self.cfg.n_layers,
+                    temperature=float(temperature),
+                )
+                spec_cell_of = spec_cell_key
 
         rows = [self.tokenizer.encode(p) for p in prompts]
         eligible = self.sp_mesh is None and _use_merged(self.cfg)
@@ -1063,6 +1110,7 @@ class ModelRunner:
                 trial_ids=trial_ids, stop_event=stop_event, faults=faults,
                 trace=trace, roofline=roofline, speculate_k=speculate_k,
                 draft_layers=int(draft_layers) if speculate_k else 0,
+                spec_control=spec_control, spec_cell_of=spec_cell_of,
             )
         if L0 == 0:
             if speculate_k:
@@ -1189,7 +1237,9 @@ class ModelRunner:
                 replica=str(getattr(self, "replica_label", "0")),
                 speculate_k=speculate_k,
                 draft_layers=int(draft_layers) if speculate_k else 0,
+                spec_control=spec_control, spec_cell_of=spec_cell_of,
             )
+            self.last_spec_control = stats.get("spec_control")
             done = [r for r in results if r is not None]
             span.add_evals(len(done))
             span.add_tokens(int(sum(len(r) for r in done)))
@@ -1227,6 +1277,8 @@ class ModelRunner:
         trace,
         speculate_k: int,
         draft_layers: int,
+        spec_control=None,
+        spec_cell_of=None,
         roofline=None,
     ) -> list[str]:
         """Paged-KV scheduled generation (``run_scheduled_paged``): full
@@ -1291,8 +1343,10 @@ class ModelRunner:
                 trace=trace, roofline=roofline,
                 replica=str(getattr(self, "replica_label", "0")),
                 speculate_k=speculate_k, draft_layers=draft_layers,
+                spec_control=spec_control, spec_cell_of=spec_cell_of,
                 decode_kernel=self.decode_kernel,
             )
+            self.last_spec_control = stats.get("spec_control")
             done = [r for r in results if r is not None]
             span.add_evals(len(done))
             span.add_tokens(int(sum(len(r) for r in done)))

@@ -84,6 +84,7 @@ from introspective_awareness_tpu.runtime.generate import (
     _chunk_plan,
     _spec_chunk_plan,
     _spec_merged_pages,
+    _spec_rounds,
     scheduler_admit,
     scheduler_decode_chunk,
     scheduler_decode_chunk_speculate,
@@ -195,6 +196,9 @@ class _InFlight:
     toks: jax.Array  # chunk: [B, ch] token slab; refill: [B] tok0
     owners: np.ndarray  # [B] queue index per slot at dispatch (-1 = free)
     seq: int = -1  # run-wide dispatch sequence number (ChunkTrace key)
+    bucket: object = None  # SpecBucket dispatched (adaptive runs only)
+    rounds: int = 0  # speculation rounds in this dispatch (waste/progress)
+    t_disp: float = 0.0  # dispatch wall clock (controller calibration)
 
 
 @dataclass
@@ -253,6 +257,8 @@ def run_scheduled(
     replica: str = "0",
     speculate_k: int = 0,
     draft_layers: int = 0,
+    spec_control=None,
+    spec_cell_of: Optional[Callable[[object], str]] = None,
     roofline=None,
 ) -> tuple[list[np.ndarray], dict]:
     """Drain ``trials`` through ``slots`` decode rows; returns per-trial
@@ -325,6 +331,20 @@ def run_scheduled(
     for reproducible merges. Host budget accounting uses the guaranteed
     minimum of one emitted token per round, so the budget-horizon and
     page-recycling arguments carry over unchanged.
+
+    ``spec_control`` (a :class:`runtime.spec_control.SpecController`,
+    requires ``speculate_k`` = its max bucket k) makes speculation
+    ADAPTIVE: before every chunk dispatch the controller picks one of its
+    static ``(k, draft_layers, width)`` buckets from per-cell acceptance
+    EWMAs; each bucket is its own already-compiled executable (the shared
+    ring is sized to the widest bucket at init), so adaptation never
+    recompiles. Per-slot accepted/live-round counts from the ``[5B]``
+    flags are attributed to cells via ``spec_cell_of(trial) -> str``
+    (default: one anonymous cell) and fed back between dispatches; every
+    decision lands in the journal (``stats["spec_control"]``) and each
+    cell's chunk acceptance in the ``iat_spec_acceptance_rate``
+    histogram. Greedy outputs stay bit-identical to every static config
+    because each bucket is individually bit-identical.
     """
     ledger = ledger if ledger is not None else NullLedger()
     B = slots
@@ -337,6 +357,10 @@ def run_scheduled(
                     "staged": bool(staged), "interrupted": False,
                     "speculate_k": int(speculate_k),
                     "draft_layers": int(draft_layers) if speculate_k else 0,
+                    "spec_control": (
+                        spec_control.snapshot()
+                        if spec_control is not None else None
+                    ),
                     **PipelineGauges().as_stats(0.0, 0),
                     **StagedGauges().as_stats(),
                     **SpecGauges().as_stats()}
@@ -367,6 +391,23 @@ def run_scheduled(
     else:
         rounds = 0
         n_chunks, ch = _chunk_plan(max_new_tokens)
+    bucket_plan = None
+    spec_ring = 0
+    if spec_control is not None:
+        if not speculate_k:
+            raise ValueError(
+                "spec_control requires speculate_k > 0 (its max bucket k)"
+            )
+        # Per-bucket rounds keep every bucket's ring use near RING_CHUNK;
+        # ONE shared cache ring is sized for the widest bucket (ring width
+        # is static cache geometry; _spec_core reads rlen at runtime).
+        bucket_plan = {
+            b: _spec_rounds(max_new_tokens, b.k, b.width)
+            for b in spec_control.buckets
+        }
+        spec_ring = max(
+            r * b.verify_width for b, r in bucket_plan.items()
+        )
     stop = None
     if stop_seqs is not None and len(stop_seqs) > 0:
         stop = jnp.asarray(np.asarray(stop_seqs, np.int32))
@@ -379,14 +420,14 @@ def run_scheduled(
                 "scheduler_init", scheduler_init, params, cfg, prefix_j,
                 slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
                 stop_width=stop_width, with_prefix=True,
-                speculate_k=speculate_k,
+                speculate_k=speculate_k, spec_ring=spec_ring,
             )
             roofline.dispatched("scheduler_init", "init")
         cache, state, pk, pv = scheduler_init(
             params, cfg, prefix_j,
             slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
             stop_width=stop_width, with_prefix=True,
-            speculate_k=speculate_k,
+            speculate_k=speculate_k, spec_ring=spec_ring,
         )
     else:
         if roofline is not None:
@@ -394,12 +435,14 @@ def run_scheduled(
                 "scheduler_init", scheduler_init, params, cfg, prefix_j,
                 slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
                 stop_width=stop_width, speculate_k=speculate_k,
+                spec_ring=spec_ring,
             )
             roofline.dispatched("scheduler_init", "init")
         cache, state = scheduler_init(
             params, cfg, prefix_j,
             slots=B, suffix_len=Ss, max_new_tokens=max_new_tokens,
             stop_width=stop_width, speculate_k=speculate_k,
+            spec_ring=spec_ring,
         )
     spec = SchedSpec(
         temperature=jnp.float32(temperature),
@@ -407,6 +450,30 @@ def run_scheduled(
         pad_id=jnp.int32(pad_id),
         stop_seqs=stop,
     )
+    if spec_control is not None:
+        # Pre-compile EVERY bucket's executable before the first real
+        # dispatch: adaptation is a host-side pick among already-compiled
+        # executables, so a mid-decode switch must never eat an XLA
+        # compile (the controller's walk is calibration-driven and not
+        # reproducible across runs, so lazy compile-on-first-pick would
+        # make wall time nondeterministic too). Throwaway calls on copied
+        # operands — donation consumes the copies, outputs are dropped.
+        t_pc = time.perf_counter()
+        for b in sorted(bucket_plan):
+            scheduler_decode_chunk_speculate(
+                params, cfg,
+                jax.tree_util.tree_map(jnp.copy, cache),
+                jax.tree_util.tree_map(jnp.copy, state),
+                spec, jnp.int32(0),
+                rounds=bucket_plan[b], k=b.k,
+                draft_layers=b.draft_layers, width=b.width,
+            )
+        ledger.event(
+            "spec_buckets_precompiled", tier="classic",
+            n=len(bucket_plan),
+            buckets=[b.label() for b in sorted(bucket_plan)],
+            s=round(time.perf_counter() - t_pc, 3),
+        )
     base_key = jax.random.key(seed)
     # Per-trial PRNG streams: a trial's samples depend on its stream id only
     # (queue index, or the caller-supplied original index on a resumed
@@ -508,10 +575,15 @@ def run_scheduled(
     m_final = _reg.counter(
         "iat_scheduler_trials_finalized_total", "trials finalized",
         labelnames=("replica",))
-    m_spec_acc = _reg.gauge(
+    # Per-cell HISTOGRAM (PR 18): each processed speculative chunk
+    # observes every live cell's accepted/drafted ratio into that cell's
+    # series, so the adaptive controller's input distribution is
+    # inspectable mid-run via /progress — not just the last write.
+    m_spec_acc = _reg.histogram(
         "iat_spec_acceptance_rate",
-        "accepted/drafted ratio over processed speculative chunks",
-        labelnames=("replica",))
+        "per-chunk per-cell accepted/drafted acceptance-rate observations",
+        labelnames=("replica", "cell"), max_series=256,
+        buckets=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0))
     m_spec_tok = _reg.gauge(
         "iat_spec_tokens_per_round",
         "emitted tokens per live speculation round",
@@ -698,24 +770,50 @@ def run_scheduled(
             if grp.cursor >= grp.n:
                 stage_pool.popleft()
 
+    def _cell(ti: int) -> str:
+        if spec_cell_of is None:
+            return ""
+        t = trials[ti]
+        return "" if t is None else str(spec_cell_of(t))
+
+    def _live_cells() -> dict[str, int]:
+        live: dict[str, int] = {}
+        for s in range(B):
+            ti = int(slot_trial[s])
+            if ti >= 0:
+                c = _cell(ti)
+                live[c] = live.get(c, 0) + 1
+        return live
+
     def _dispatch_chunk() -> None:
         nonlocal cache, state, g, d_seq
         page = jnp.int32(g % n_chunks) if n_chunks else jnp.int32(0)
+        bkt = None
         if speculate_k:
+            rounds_d, k_d, dl_d, w_d = rounds, speculate_k, draft_layers, 1
+            if spec_control is not None:
+                # Host-side runtime decision: pick the next chunk's bucket
+                # from the live cells' EWMAs. Every bucket's executable is
+                # cached on its static (rounds, k, draft_layers, width)
+                # key, so a switch is just a different dict lookup.
+                bkt = spec_control.choose(_live_cells(), chunk=g)
+                rounds_d = bucket_plan[bkt]
+                k_d, dl_d, w_d = bkt.k, bkt.draft_layers, bkt.width
             if roofline is not None:
                 roofline.capture_once(
                     "scheduler_decode_chunk_speculate",
                     scheduler_decode_chunk_speculate,
                     params, cfg, cache, state, spec, page,
-                    rounds=rounds, k=speculate_k, draft_layers=draft_layers,
+                    rounds=rounds_d, k=k_d, draft_layers=dl_d, width=w_d,
                 )
                 roofline.dispatched(
                     "scheduler_decode_chunk_speculate", "chunk")
             cache, state, toks, flags = scheduler_decode_chunk_speculate(
                 params, cfg, cache, state, spec, page,
-                rounds=rounds, k=speculate_k, draft_layers=draft_layers,
+                rounds=rounds_d, k=k_d, draft_layers=dl_d, width=w_d,
             )
         else:
+            rounds_d = 0
             if roofline is not None:
                 roofline.capture_once(
                     "scheduler_decode_chunk", scheduler_decode_chunk,
@@ -729,13 +827,15 @@ def run_scheduled(
         flags.copy_to_host_async()
         toks.copy_to_host_async()
         pending.append(_InFlight("chunk", flags, toks, slot_trial.copy(),
-                                 d_seq))
+                                 d_seq, bucket=bkt, rounds=rounds_d,
+                                 t_disp=time.perf_counter()))
         if trace is not None:
             trace.dispatch("chunk", d_seq)
         d_seq += 1
         gauges.dispatched(len(pending))
         assigned = slot_trial >= 0
-        rem[assigned] = np.maximum(rem[assigned] - ch, 0)
+        step = rounds_d if speculate_k else ch
+        rem[assigned] = np.maximum(rem[assigned] - step, 0)
 
     def _process_one() -> None:
         nonlocal occupancy_sum, waste_steps, chunks_done, last_done
@@ -755,22 +855,47 @@ def run_scheduled(
             # was assigned at dispatch and not done at the preceding event.
             live = int(((ev.owners >= 0) & ~last_done).sum())
             occupancy_sum += live / B
-            waste_steps += (B - live) * ch
+            waste_steps += (B - live) * (ev.rounds if speculate_k else ch)
             chunks_done += 1
             m_chunks.inc(**_rl)
             m_occ.set(live / B, **_rl)
             cnt = None
             if speculate_k:
-                # Speculative [3B+2] flags: per-slot emitted counts gate the
-                # FRONT-PACKED token slab; the trailing pair holds the
-                # chunk's accepted/drafted totals (drafted / k = exact live
-                # slot-round count, so tokens-per-round is device truth).
+                # Speculative [5B] flags: per-slot emitted counts gate the
+                # FRONT-PACKED token slab; the per-slot accepted/live-round
+                # tails attribute acceptance to grid cells (drafted =
+                # k * live rounds, so tokens-per-round is device truth).
                 cnt = flags[2 * B : 3 * B]
-                acc, drf = int(flags[3 * B]), int(flags[3 * B + 1])
-                pgauges.chunk(acc, drf, int(cnt.sum()), drf // speculate_k)
-                if pgauges.drafted:
-                    m_spec_acc.set(
-                        pgauges.accepted / pgauges.drafted, **_rl)
+                acc_sl = flags[3 * B : 4 * B]
+                lr_sl = flags[4 * B : 5 * B]
+                k_d = ev.bucket.k if ev.bucket is not None else speculate_k
+                lrs = int(lr_sl.sum())
+                pgauges.chunk(
+                    int(acc_sl.sum()), k_d * lrs, int(cnt.sum()), lrs
+                )
+                per_cell: dict[str, list] = {}
+                for s in range(B):
+                    ti = int(ev.owners[s])
+                    if ti < 0 or int(lr_sl[s]) <= 0:
+                        continue
+                    agg = per_cell.setdefault(_cell(ti), [0, 0, 0])
+                    agg[0] += int(acc_sl[s])
+                    agg[1] += k_d * int(lr_sl[s])
+                    agg[2] += int(cnt[s])
+                wall_c = max(0.0, (t0 + wait_s) - ev.t_disp)
+                first = True
+                for c, (a_, d_, e_) in sorted(per_cell.items()):
+                    m_spec_acc.observe(a_ / d_, cell=c, **_rl)
+                    if spec_control is not None:
+                        # Chunk wall/emitted calibrate the DISPATCHED
+                        # bucket once per chunk (first cell carries it).
+                        spec_control.observe(
+                            c, a_, d_,
+                            emitted=int(cnt.sum()) if first else 0,
+                            wall_s=wall_c if first else 0.0,
+                            bucket=ev.bucket,
+                        )
+                        first = False
                 if pgauges.live_rounds:
                     m_spec_tok.set(
                         pgauges.emitted / pgauges.live_rounds, **_rl)
@@ -900,6 +1025,9 @@ def run_scheduled(
         "interrupted": bool(interrupted),
         "speculate_k": int(speculate_k),
         "draft_layers": int(draft_layers) if speculate_k else 0,
+        "spec_control": (
+            spec_control.snapshot() if spec_control is not None else None
+        ),
         **gauges.as_stats(wall_s, chunks_done),
         **sgauges.as_stats(),
         **pgauges.as_stats(),
@@ -985,6 +1113,8 @@ def run_scheduled_paged(
     replica: str = "0",
     speculate_k: int = 0,
     draft_layers: int = 0,
+    spec_control=None,
+    spec_cell_of: Optional[Callable[[object], str]] = None,
     feed: Optional[SchedulerFeed] = None,
     token_cb: Optional[Callable[[int, np.ndarray], None]] = None,
     max_prompt_len: Optional[int] = None,
@@ -1070,6 +1200,10 @@ def run_scheduled_paged(
                     "decode_kernel": decode_kernel,
                     "page_size": pg, "speculate_k": int(speculate_k),
                     "draft_layers": int(draft_layers) if speculate_k else 0,
+                    "spec_control": (
+                        spec_control.snapshot()
+                        if spec_control is not None else None
+                    ),
                     "share_hits": 0, "share_misses": 0,
                     "share_hit_rate": 0.0, "prompt_pool_pages": 0,
                     "pages_in_use_peak": 0, "pages_cached": 0,
@@ -1113,6 +1247,20 @@ def run_scheduled_paged(
     else:
         rounds = 0
         ch_host = ring_w
+    bucket_plan = None
+    if spec_control is not None:
+        if not speculate_k:
+            raise ValueError(
+                "spec_control requires speculate_k > 0 (its max bucket k)"
+            )
+        # Paged speculative executables assemble a FRESH exactly-sized
+        # ring per call, so per-bucket ring widths cost nothing; the
+        # compacting pool fold is count-addressed, so the pool geometry
+        # above (sized from the static max-k plan) holds for any bucket.
+        bucket_plan = {
+            b: _spec_rounds(max_new_tokens, b.k, b.width)
+            for b in spec_control.buckets
+        }
     Pp = int(prompt_pool_pages or geom["min_prompt_pages"])
     if Pp < geom["min_prompt_pages"]:
         raise ValueError(
@@ -1242,10 +1390,15 @@ def run_scheduled_paged(
     m_final = _reg.counter(
         "iat_scheduler_trials_finalized_total", "trials finalized",
         labelnames=("replica",))
-    m_spec_acc = _reg.gauge(
+    # Per-cell HISTOGRAM (PR 18): each processed speculative chunk
+    # observes every live cell's accepted/drafted ratio into that cell's
+    # series, so the adaptive controller's input distribution is
+    # inspectable mid-run via /progress — not just the last write.
+    m_spec_acc = _reg.histogram(
         "iat_spec_acceptance_rate",
-        "accepted/drafted ratio over processed speculative chunks",
-        labelnames=("replica",))
+        "per-chunk per-cell accepted/drafted acceptance-rate observations",
+        labelnames=("replica", "cell"), max_series=256,
+        buckets=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0))
     m_spec_tok = _reg.gauge(
         "iat_spec_tokens_per_round",
         "emitted tokens per live speculation round",
@@ -1488,24 +1641,70 @@ def run_scheduled_paged(
         )
         plain_fn, plain_name = paged_decode_chunk, "paged_decode_chunk"
 
+    if spec_control is not None:
+        # Same contract as the classic loop: every bucket's executable is
+        # compiled up front on copied operands, so the controller's
+        # per-chunk switches never hit XLA mid-decode. The bucketed ring
+        # (rounds_b * (1 + width*k)) is built inside the wrapper, so the
+        # static pool operands are valid for every bucket.
+        t_pc = time.perf_counter()
+        for b in sorted(bucket_plan):
+            spec_fn(
+                params, cfg, ppk, ppv,
+                jnp.copy(dpk), jnp.copy(dpv),
+                jnp.copy(mpos), jnp.copy(mvalid),
+                jax.tree_util.tree_map(jnp.copy, state),
+                spec, jnp.asarray(ptab_h), dtab_j,
+                rounds=bucket_plan[b], k=b.k,
+                draft_layers=b.draft_layers, width=b.width,
+            )
+        ledger.event(
+            "spec_buckets_precompiled", tier=spec_name,
+            n=len(bucket_plan),
+            buckets=[b.label() for b in sorted(bucket_plan)],
+            s=round(time.perf_counter() - t_pc, 3),
+        )
+
+    def _cell(ti: int) -> str:
+        if spec_cell_of is None:
+            return ""
+        t = trials[ti]
+        return "" if t is None else str(spec_cell_of(t))
+
+    def _live_cells() -> dict[str, int]:
+        live: dict[str, int] = {}
+        for s in range(B):
+            ti = int(slot_trial[s])
+            if ti >= 0:
+                c = _cell(ti)
+                live[c] = live.get(c, 0) + 1
+        return live
+
     def _dispatch_chunk() -> None:
         nonlocal dpk, dpv, mpos, mvalid, state, g, d_seq
         ptab_j = jnp.asarray(ptab_h)
+        bkt = None
+        rounds_d = rounds
         if speculate_k:
+            k_d, dl_d, w_d = speculate_k, draft_layers, 1
+            if spec_control is not None:
+                bkt = spec_control.choose(_live_cells(), chunk=g)
+                rounds_d = bucket_plan[bkt]
+                k_d, dl_d, w_d = bkt.k, bkt.draft_layers, bkt.width
             if roofline is not None:
                 roofline.capture_once(
                     spec_name,
                     spec_fn,
                     params, cfg, ppk, ppv, dpk, dpv, mpos, mvalid, state,
                     spec, ptab_j, dtab_j,
-                    rounds=rounds, k=speculate_k, draft_layers=draft_layers,
+                    rounds=rounds_d, k=k_d, draft_layers=dl_d, width=w_d,
                 )
                 roofline.dispatched(spec_name, "chunk")
             dpk, dpv, mpos, mvalid, state, toks, flags = (
                 spec_fn(
                     params, cfg, ppk, ppv, dpk, dpv, mpos, mvalid, state,
                     spec, ptab_j, dtab_j,
-                    rounds=rounds, k=speculate_k, draft_layers=draft_layers,
+                    rounds=rounds_d, k=k_d, draft_layers=dl_d, width=w_d,
                 )
             )
         else:
@@ -1525,13 +1724,15 @@ def run_scheduled_paged(
         flags.copy_to_host_async()
         toks.copy_to_host_async()
         pending.append(_InFlight("chunk", flags, toks, slot_trial.copy(),
-                                 d_seq))
+                                 d_seq, bucket=bkt, rounds=rounds_d,
+                                 t_disp=time.perf_counter()))
         if trace is not None:
             trace.dispatch("chunk", d_seq)
         d_seq += 1
         gauges.dispatched(len(pending))
         assigned = slot_trial >= 0
-        rem[assigned] = np.maximum(rem[assigned] - ch_host, 0)
+        step = rounds_d if speculate_k else ch_host
+        rem[assigned] = np.maximum(rem[assigned] - step, 0)
 
     def _process_one() -> None:
         nonlocal occupancy_sum, waste_steps, chunks_done, last_done
@@ -1549,18 +1750,45 @@ def run_scheduled_paged(
         if ev.kind == "chunk":
             live = int(((ev.owners >= 0) & ~last_done).sum())
             occupancy_sum += live / B
-            waste_steps += (B - live) * ch_host
+            waste_steps += (
+                (B - live) * (ev.rounds if speculate_k else ch_host)
+            )
             chunks_done += 1
             m_chunks.inc(**_rl)
             m_occ.set(live / B, **_rl)
             cnt = None
             if speculate_k:
+                # [5B] flags — see the classic loop's parse for the
+                # per-cell attribution contract.
                 cnt = flags[2 * B : 3 * B]
-                acc, drf = int(flags[3 * B]), int(flags[3 * B + 1])
-                pgauges.chunk(acc, drf, int(cnt.sum()), drf // speculate_k)
-                if pgauges.drafted:
-                    m_spec_acc.set(
-                        pgauges.accepted / pgauges.drafted, **_rl)
+                acc_sl = flags[3 * B : 4 * B]
+                lr_sl = flags[4 * B : 5 * B]
+                k_d = ev.bucket.k if ev.bucket is not None else speculate_k
+                lrs = int(lr_sl.sum())
+                pgauges.chunk(
+                    int(acc_sl.sum()), k_d * lrs, int(cnt.sum()), lrs
+                )
+                per_cell: dict[str, list] = {}
+                for s in range(B):
+                    ti = int(ev.owners[s])
+                    if ti < 0 or int(lr_sl[s]) <= 0:
+                        continue
+                    agg = per_cell.setdefault(_cell(ti), [0, 0, 0])
+                    agg[0] += int(acc_sl[s])
+                    agg[1] += k_d * int(lr_sl[s])
+                    agg[2] += int(cnt[s])
+                wall_c = max(0.0, (t0 + wait_s) - ev.t_disp)
+                first = True
+                for c, (a_, d_, e_) in sorted(per_cell.items()):
+                    m_spec_acc.observe(a_ / d_, cell=c, **_rl)
+                    if spec_control is not None:
+                        spec_control.observe(
+                            c, a_, d_,
+                            emitted=int(cnt.sum()) if first else 0,
+                            wall_s=wall_c if first else 0.0,
+                            bucket=ev.bucket,
+                        )
+                        first = False
                 if pgauges.live_rounds:
                     m_spec_tok.set(
                         pgauges.emitted / pgauges.live_rounds, **_rl)
@@ -1770,6 +1998,9 @@ def run_scheduled_paged(
         "page_size": pg,
         "speculate_k": int(speculate_k),
         "draft_layers": int(draft_layers) if speculate_k else 0,
+        "spec_control": (
+            spec_control.snapshot() if spec_control is not None else None
+        ),
         "share_hits": int(share_hits),
         "share_misses": int(share_misses),
         "share_hit_rate": round(share_hits / tot, 4) if tot else 0.0,

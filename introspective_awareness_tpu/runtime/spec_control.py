@@ -1,0 +1,369 @@
+"""Online speculation controller: per-cell acceptance EWMAs drive the
+(k, draft_layers, width) bucket between chunk dispatches.
+
+The controller is pure host-side bookkeeping. It never touches jax: the
+scheduler feeds it per-cell accepted/drafted counts harvested from each
+speculative chunk's flags, and asks it which bucket to dispatch next.
+Buckets come from a SMALL STATIC SET fixed at construction, so every
+bucket's executable compiles exactly once (jit caches on the static
+``(rounds, k, draft_layers, width)`` tuple) and adaptation is a pure
+runtime decision — ``tests/test_spec_control.py`` pins the no-recompile
+property with a compile-count probe.
+
+Model
+-----
+Let ``r`` be a cell's EWMA per-position acceptance rate and ``(k, w)``
+a bucket's depth/width. The first tree level proposes ``w`` distinct
+candidates, deeper levels follow one chain each, so
+
+    p1      = 1 - (1 - r) ** w          # any first-level node accepted
+    E[acc]  = p1 * sum(r**i for i in range(k))
+    E[emit] = 1 + E[acc]                # correction/bonus always emits
+
+which degenerates to the classic ``sum(r**i for i in range(k + 1))`` at
+``w == 1``. Cost is measured in full-depth forward equivalents:
+
+    drafts  = k            if w == 1 else 1 + w * (k - 1)
+    cost    = drafts * draft_layers / n_layers + 1 + c0 * (drafts + 1)
+
+``c0`` charges per-launch overhead (dispatch + ring bookkeeping), the
+term that makes wide-shallow trees win over deep-linear chains exactly
+when acceptance is low. Predicted throughput is calibrated per bucket
+by an EWMA of measured emitted-tokens-per-second whenever the scheduler
+reports wall time, so a mis-modelled backend converges to measurement.
+
+Decisions maximize ``sum_c n_c * pref_c(b) * E_c[emit](b) / cost(b)``
+over live cells ``c`` with ``n_c`` occupied slots, with hysteresis: the
+incumbent is kept unless a challenger beats it by ``hysteresis``
+relative margin. Every decision (kept or switched) is journaled; the
+scheduler folds the journal into its stats and the sweep manifest.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, NamedTuple, Optional, Sequence
+
+
+#: --speculate-k auto defaults: linear ladder depth and tree branching.
+AUTO_K_MAX = 4
+AUTO_WIDTH_MAX = 2
+
+
+def spec_cell_key(trial) -> str:
+    """Grid-cell key for a scheduler trial (TrialRequest / PagedTrial —
+    anything with ``steer_layer``/``steer_strength``): the controller's
+    EWMA granularity. Steering layer and strength are what move the
+    drafter's acceptance (above-cut injection is invisible to the
+    drafter), so they ARE the cell identity."""
+    return (
+        f"L{int(trial.steer_layer)}|s{float(trial.steer_strength):g}"
+    )
+
+
+class SpecBucket(NamedTuple):
+    """One statically-compiled speculation shape."""
+
+    k: int  # draft depth (tokens per chain)
+    draft_layers: int  # drafter depth (early-exit layer count)
+    width: int = 1  # tree branching at the first draft level
+
+    @property
+    def verify_width(self) -> int:
+        """Verify-window token count: prev + width * k tree nodes."""
+        return 1 + self.width * self.k
+
+    @property
+    def drafts(self) -> int:
+        """Draft forwards per round (see module docstring)."""
+        return self.k if self.width == 1 else 1 + self.width * (self.k - 1)
+
+    def label(self) -> str:
+        return f"k{self.k}w{self.width}d{self.draft_layers}"
+
+
+def default_buckets(
+    k_max: int,
+    draft_layers: int,
+    n_layers: int,
+    *,
+    width_max: int = 2,
+) -> tuple[SpecBucket, ...]:
+    """The stock static set for ``--speculate-k auto``: linear ladders at
+    1..k_max plus one wide-tree shape at the deepest k (the low-acceptance
+    escape hatch). Kept deliberately tiny — each bucket is one compiled
+    executable per scheduler tier."""
+    k_max = max(1, int(k_max))
+    dl = min(max(1, int(draft_layers)), max(1, n_layers - 1))
+    out = [SpecBucket(k, dl, 1) for k in range(1, k_max + 1)]
+    if width_max > 1 and k_max >= 2:
+        out.append(SpecBucket(k_max, dl, int(width_max)))
+    return tuple(out)
+
+
+class _CellStat:
+    __slots__ = ("rate", "n_obs", "accepted", "drafted")
+
+    def __init__(self, init_rate: float):
+        self.rate = float(init_rate)
+        self.n_obs = 0
+        self.accepted = 0
+        self.drafted = 0
+
+
+class SpecController:
+    """Per-cell EWMA acceptance -> bucket decisions. See module docstring.
+
+    ``cell_policy`` (optional) maps a cell key to a policy name; policies
+    bias the objective per cell: ``"interactive"`` tenants prefer DEEP /
+    NARROW speculation (latency: longest accepted run per launch),
+    ``"bulk"`` tenants are throughput-neutral but tolerate WIDE trees.
+    Unknown / absent policies are neutral.
+    """
+
+    #: multiplicative per-bucket preference by policy, keyed on width
+    _POLICY_PREF: dict[str, Callable[[SpecBucket], float]] = {
+        "interactive": lambda b: 1.0 if b.width == 1 else 0.85,
+        "bulk": lambda b: 1.0 if b.width > 1 else 0.95,
+    }
+
+    def __init__(
+        self,
+        buckets: Sequence[SpecBucket],
+        *,
+        n_layers: int,
+        ewma: float = 0.3,
+        hysteresis: float = 0.08,
+        c0: float = 0.15,
+        init_rate: float = 1.0,
+        temperature: float = 0.0,
+        cell_policy: Optional[Callable[[str], Optional[str]]] = None,
+        journal_cap: int = 512,
+    ):
+        if not buckets:
+            raise ValueError("SpecController needs at least one bucket")
+        seen = set()
+        for b in buckets:
+            if b.k < 1 or b.width < 1 or not (0 < b.draft_layers < n_layers):
+                raise ValueError(f"invalid bucket {b} for n_layers={n_layers}")
+            if b in seen:
+                raise ValueError(f"duplicate bucket {b}")
+            seen.add(b)
+        # temperature > 0 keeps distribution-identity by rejection-sampling
+        # the FIRST chain only, so wide trees buy nothing there — drop them
+        # from the candidate set instead of dispatching dead width.
+        if float(temperature) > 0.0:
+            narrow = tuple(b for b in buckets if b.width == 1)
+            buckets = narrow if narrow else tuple(buckets)
+        self.buckets: tuple[SpecBucket, ...] = tuple(buckets)
+        self.n_layers = int(n_layers)
+        self.ewma = float(ewma)
+        self.hysteresis = float(hysteresis)
+        self.c0 = float(c0)
+        self.init_rate = float(init_rate)
+        self.cell_policy = cell_policy
+        self.journal_cap = int(journal_cap)
+        self.cells: dict[str, _CellStat] = {}
+        # measured/predicted tokens-per-second calibration, per bucket
+        self._calib: dict[SpecBucket, float] = {}
+        self.current: SpecBucket = self.buckets[0]
+        self.decisions = 0
+        self.adaptations = 0
+        self.journal: list[dict] = []
+        self._journal_dropped = 0
+
+    # ------------------------------------------------------------------ #
+    # observation                                                        #
+    # ------------------------------------------------------------------ #
+
+    def observe(
+        self,
+        cell: str,
+        accepted: int,
+        drafted: int,
+        *,
+        emitted: int = 0,
+        wall_s: float = 0.0,
+        bucket: Optional[SpecBucket] = None,
+    ) -> None:
+        """Fold one chunk's per-cell counts into the cell EWMA. ``drafted``
+        counts draft POSITIONS along candidate paths (k per live round),
+        so ``accepted / drafted`` is the per-position acceptance rate the
+        throughput model consumes. ``emitted``/``wall_s`` (when the
+        scheduler has them) calibrate the dispatched bucket's predicted
+        tokens-per-second toward measurement."""
+        if drafted <= 0:
+            return
+        st = self.cells.get(cell)
+        if st is None:
+            st = self.cells[cell] = _CellStat(self.init_rate)
+        obs = min(1.0, max(0.0, accepted / drafted))
+        a = self.ewma
+        st.rate = (1.0 - a) * st.rate + a * obs
+        st.n_obs += 1
+        st.accepted += int(accepted)
+        st.drafted += int(drafted)
+        b = bucket or self.current
+        if emitted > 0 and wall_s > 0.0 and b in set(self.buckets):
+            meas = emitted / wall_s
+            pred = self._predicted_tps(b)
+            if pred > 0.0:
+                ratio = meas / pred
+                old = self._calib.get(b)
+                self._calib[b] = (
+                    ratio if old is None else (1.0 - a) * old + a * ratio
+                )
+
+    def rate(self, cell: str) -> float:
+        st = self.cells.get(cell)
+        return st.rate if st is not None else self.init_rate
+
+    # ------------------------------------------------------------------ #
+    # model                                                              #
+    # ------------------------------------------------------------------ #
+
+    def expected_emitted(self, bucket: SpecBucket, r: float) -> float:
+        r = min(1.0, max(0.0, r))
+        p1 = 1.0 - (1.0 - r) ** bucket.width
+        geo = sum(r**i for i in range(bucket.k))
+        return 1.0 + p1 * geo
+
+    def cost(self, bucket: SpecBucket) -> float:
+        d = bucket.drafts
+        return (
+            d * bucket.draft_layers / self.n_layers
+            + 1.0
+            + self.c0 * (d + 1)
+        )
+
+    def _predicted_tps(self, bucket: SpecBucket) -> float:
+        """Model throughput in emitted tokens per full-forward-equivalent
+        cost unit, aggregated over known cells (uniform if none)."""
+        rs = [s.rate for s in self.cells.values()] or [self.init_rate]
+        e = sum(self.expected_emitted(bucket, r) for r in rs) / len(rs)
+        return e / self.cost(bucket)
+
+    def score(
+        self, bucket: SpecBucket, live_cells: dict[str, int]
+    ) -> float:
+        tot = 0.0
+        items = live_cells.items() if live_cells else [("", 1)]
+        for cell, n in items:
+            if n <= 0:
+                continue
+            pref = 1.0
+            if self.cell_policy is not None and cell:
+                pol = self.cell_policy(cell)
+                fn = self._POLICY_PREF.get(pol) if pol else None
+                if fn is not None:
+                    pref = fn(bucket)
+            tot += n * pref * self.expected_emitted(bucket, self.rate(cell))
+        s = tot / self.cost(bucket)
+        # Calibration must only express RELATIVE bucket differences: a
+        # bucket that was never dispatched has no measured ratio, and
+        # scoring it raw against an incumbent whose ratio folds in the
+        # machine's absolute throughput would lock the incumbent in
+        # forever. Fall back to the mean known ratio so uncalibrated
+        # challengers compete on the cost model alone.
+        calib = self._calib.get(bucket)
+        if calib is None and self._calib:
+            known = [v for v in self._calib.values()
+                     if math.isfinite(v) and v > 0.0]
+            if known:
+                calib = sum(known) / len(known)
+        if calib is not None and math.isfinite(calib) and calib > 0.0:
+            s *= calib
+        return s
+
+    # ------------------------------------------------------------------ #
+    # decision                                                           #
+    # ------------------------------------------------------------------ #
+
+    def choose(
+        self,
+        live_cells: Optional[dict[str, int]] = None,
+        *,
+        chunk: Optional[int] = None,
+    ) -> SpecBucket:
+        """Pick the bucket for the NEXT chunk dispatch and journal the
+        decision. Hysteresis keeps the incumbent unless a challenger wins
+        by a relative margin, so jitter in the EWMA can't thrash the
+        executable stream."""
+        live = dict(live_cells or {})
+        scores = {b: self.score(b, live) for b in self.buckets}
+        best = max(self.buckets, key=lambda b: scores[b])
+        cur = self.current
+        switched = False
+        # The first decision has no incumbent worth protecting — nothing
+        # was dispatched yet, so adopt the argmax outright; hysteresis
+        # only guards an executable stream that actually exists.
+        first = self.decisions == 0
+        if best != cur and (
+            first or scores[best] > scores[cur] * (1.0 + self.hysteresis)
+        ):
+            self.current = best
+            switched = True
+            self.adaptations += 1
+        self.decisions += 1
+        entry = {
+            "decision": self.decisions,
+            "bucket": self.current.label(),
+            "k": self.current.k,
+            "width": self.current.width,
+            "draft_layers": self.current.draft_layers,
+            "switched": switched,
+            "cells": {
+                c: round(self.rate(c), 4) for c in sorted(live)
+            },
+            "live": {c: int(n) for c, n in sorted(live.items())},
+            "scores": {b.label(): round(s, 4) for b, s in scores.items()},
+        }
+        if chunk is not None:
+            entry["chunk"] = int(chunk)
+        if len(self.journal) < self.journal_cap:
+            self.journal.append(entry)
+        else:
+            self._journal_dropped += 1
+        return self.current
+
+    # ------------------------------------------------------------------ #
+    # reporting                                                          #
+    # ------------------------------------------------------------------ #
+
+    def snapshot(self) -> dict:
+        """Manifest/stats block: static config + final state + journal."""
+        return {
+            "buckets": [b.label() for b in self.buckets],
+            "decisions": int(self.decisions),
+            "adaptations": int(self.adaptations),
+            "final_bucket": self.current.label(),
+            "cells": {
+                c: {
+                    "rate": round(st.rate, 4),
+                    "n_obs": int(st.n_obs),
+                    "accepted": int(st.accepted),
+                    "drafted": int(st.drafted),
+                }
+                for c, st in sorted(self.cells.items())
+            },
+            "calibration": {
+                b.label(): round(v, 4) for b, v in self._calib.items()
+            },
+            "journal": list(self.journal),
+            "journal_dropped": int(self._journal_dropped),
+        }
+
+
+def parse_speculate_k(value) -> tuple[bool, int]:
+    """CLI/runner helper: ``--speculate-k`` accepts an int (static k,
+    0 = off) or the string ``"auto"`` (adaptive controller). Returns
+    ``(auto, k)`` where ``k`` is the static k (0 in auto mode)."""
+    if isinstance(value, str):
+        v = value.strip().lower()
+        if v == "auto":
+            return True, 0
+        value = int(v)
+    k = int(value)
+    if k < 0:
+        raise ValueError(f"--speculate-k must be >= 0 or 'auto', got {k}")
+    return False, k

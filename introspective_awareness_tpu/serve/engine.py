@@ -47,6 +47,13 @@ from introspective_awareness_tpu.runtime.scheduler import (
     SchedulerFeed,
     run_scheduled_paged,
 )
+from introspective_awareness_tpu.runtime.spec_control import (
+    AUTO_K_MAX,
+    SpecController,
+    default_buckets,
+    parse_speculate_k,
+    spec_cell_key,
+)
 from introspective_awareness_tpu.serve.request import (
     QuotaError,
     RequestError,
@@ -105,6 +112,8 @@ class ServeEngine(SchedulerFeed):
         replica: str = "serve",
         trace=None,
         roofline=None,
+        speculate_k=0,
+        draft_layers: Optional[int] = None,
     ) -> None:
         self.runner = runner
         self.slots = int(slots)
@@ -113,6 +122,16 @@ class ServeEngine(SchedulerFeed):
         self.temperature = float(temperature)
         self.seed = int(seed)
         self.preempt_after_s = float(preempt_after_s)
+        # Self-speculative decode for the serving loop: int k (static) or
+        # "auto" (per-chunk controller; see start() for the priority-aware
+        # policy wiring). Keyed per request priority so interactive tenants
+        # steer toward deep/narrow buckets and bulk toward wide trees.
+        self._spec_auto, self.speculate_k = parse_speculate_k(speculate_k)
+        if self._spec_auto:
+            self.speculate_k = min(
+                AUTO_K_MAX, max(1, self.max_new_tokens - 1))
+        self.draft_layers = draft_layers
+        self._spec_priority: dict[int, str] = {}
         self.journal = journal
         self.replica = str(replica)
         # Optional flight recorder + roofline meter for the serving loop:
@@ -211,6 +230,11 @@ class ServeEngine(SchedulerFeed):
             self._next_stream = max(self._next_stream, sid + 1)
             st = ResponseStream(req, trial, sid)
             self._streams[sid] = st
+            # id(trial) is stable for the stream's lifetime (the trial
+            # object rides the scheduler queue, including preemption
+            # requeues) — the spec controller's cell key folds the
+            # request's priority class in through this map.
+            self._spec_priority[id(trial)] = req.priority
             if self.journal is not None and not recovered:
                 self.journal.record_request(
                     req.rid, {**req.spec(), "stream": sid}
@@ -340,6 +364,7 @@ class ServeEngine(SchedulerFeed):
                 self._run_order.remove(int(sid))
         if st is None:
             return
+        self._spec_priority.pop(id(st.trial), None)
         text = self.runner._decode_row(np.asarray(toks))
         self.tenants.on_finish(st.req.tenant)
         self._c_completed.inc(priority=st.req.priority)
@@ -357,12 +382,49 @@ class ServeEngine(SchedulerFeed):
             "trace_id": st.trace_id,
         })
 
+    # -- speculation policy (scheduler thread) ------------------------------
+
+    def _spec_cell(self, trial) -> str:
+        """Controller cell key for one live trial: priority class first so
+        the policy hook can read it back, then the steering cell."""
+        pr = self._spec_priority.get(id(trial), "bulk")
+        return f"{pr}|{spec_cell_key(trial)}"
+
+    @staticmethod
+    def _spec_policy(cell: str) -> Optional[str]:
+        # interactive -> deep/narrow bias, bulk -> wide-tree bias
+        # (SpecController._POLICY_PREF); unknown prefixes are neutral.
+        pr = cell.split("|", 1)[0]
+        return pr if pr in ("interactive", "bulk") else None
+
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "ServeEngine":
         if self._thread is not None:
             raise RuntimeError("engine already started")
         r = self.runner
+
+        spec_k = int(self.speculate_k)
+        dl = None
+        spec_control = None
+        spec_cell_of = None
+        if spec_k:
+            nl = int(r.cfg.n_layers)
+            dl = (int(self.draft_layers) if self.draft_layers
+                  else max(1, nl // 2))
+            if not (0 < dl < nl):
+                raise ValueError(
+                    f"draft_layers={dl} must be in (0, {nl}) for "
+                    f"self-speculative serving")
+            if self._spec_auto:
+                spec_control = SpecController(
+                    default_buckets(spec_k, dl, nl),
+                    n_layers=nl,
+                    temperature=self.temperature,
+                    cell_policy=self._spec_policy,
+                )
+                spec_cell_of = self._spec_cell
+        self.spec_control = spec_control
 
         def _loop() -> None:
             try:
@@ -385,6 +447,10 @@ class ServeEngine(SchedulerFeed):
                     trace=self.trace,
                     roofline=self.roofline,
                     decode_kernel=getattr(r, "decode_kernel", "xla"),
+                    speculate_k=spec_k,
+                    draft_layers=dl,
+                    spec_control=spec_control,
+                    spec_cell_of=spec_cell_of,
                 )
             except BaseException as e:  # noqa: BLE001 — surfaced at close()
                 self._loop_error = e

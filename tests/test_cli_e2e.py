@@ -372,3 +372,23 @@ def test_reevaluate_judge_without_model_load(sweep_out, capsys, monkeypatch):
     assert data["metrics"]["detection_hit_rate"] == 1.0
     assert data["metrics"]["detection_false_alarm_rate"] == 1.0
     assert data["results"][0]["evaluations"]["claims_detection"]["claims_detection"]
+
+
+def test_speculate_requires_continuous_scheduler(tmp_path, capsys):
+    # The batch scheduler has no per-slot decode rounds to speculate over,
+    # and the adaptive controller needs per-chunk dispatch decisions only
+    # the continuous scheduler makes: reject at CLI parse, exit 2, before
+    # any model loads.
+    for flag in ("auto", "3"):
+        rc = _run(tmp_path, extra=["--speculate-k", flag])
+        assert rc == 2
+        out = capsys.readouterr().out
+        assert "--speculate-k requires --scheduler continuous" in out
+
+
+def test_speculate_k_rejects_garbage(capsys):
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit) as ei:
+        _run(Path("/nonexistent"), extra=["--speculate-k", "fast"])
+    assert ei.value.code == 2

@@ -138,6 +138,35 @@ class TestProgressTracker:
         assert doc["eta_s"] is None  # JSON null, not NaN/Infinity
         assert doc["evals_per_s"] == 0.0
 
+    def test_progress_surfaces_histograms_per_series(self):
+        """Labeled histograms (the adaptive controller's per-cell
+        acceptance input) must show up in /progress as count/mean/p50
+        per label set — inspectable mid-run, not just in /metrics."""
+        from introspective_awareness_tpu.obs.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        h = reg.histogram(
+            "iat_spec_acceptance_rate", "per-cell acceptance",
+            labelnames=("cell",), buckets=(0.25, 0.5, 0.75, 1.0),
+        )
+        for v in (0.1, 0.2, 0.9):
+            h.observe(v, cell="L1|s4")
+        h.observe(1.0, cell="L14|s128")
+        srv = MetricsServer(registry=reg, progress=ProgressTracker()).start()
+        try:
+            with urllib.request.urlopen(
+                f"{srv.url}/progress", timeout=10
+            ) as r:
+                doc = json.loads(r.read().decode())
+        finally:
+            srv.stop()
+        hs = doc["histograms"]
+        lo = hs['iat_spec_acceptance_rate{cell=L1|s4}']
+        hi = hs['iat_spec_acceptance_rate{cell=L14|s128}']
+        assert lo["count"] == 3 and hi["count"] == 1
+        assert abs(lo["mean"] - 0.4) < 1e-6
+        assert lo["p50"] <= 0.5 < hi["p50"]
+
     def test_eta_appears_once_work_completes(self):
         p = ProgressTracker()
         p.set_total(4)

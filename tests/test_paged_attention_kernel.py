@@ -201,7 +201,7 @@ def _ab_identity(cfg, params, slots, speculate_k, page_sizes, temp=0.0):
 
 
 @pytest.mark.parametrize("speculate_k,page_sizes", [
-    (0, (8, 16, 64)),  # plain decode: full page-size sweep
+    (0, (16,)),        # plain anchor; pg {8,64} ride the slow sweep
     (3, (16,)),        # speculative anchor; full sweep in the slow lane
 ])
 def test_pallas_decode_token_identity(setup, speculate_k, page_sizes):
@@ -215,6 +215,7 @@ def test_pallas_decode_token_identity(setup, speculate_k, page_sizes):
 
 @pytest.mark.slow  # interpret-mode e2e; CI kernel-interpret lane runs these
 @pytest.mark.parametrize("slots,speculate_k,page_sizes", [
+    (2, 0, (8, 64)),        # completes the plain page-size sweep
     (2, 3, (8, 64)),        # completes the speculative page-size sweep
     (4, 0, (8, 16, 64)),    # wide-slot plain
     (4, 3, (8, 16, 64)),    # wide-slot speculative

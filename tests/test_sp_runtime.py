@@ -79,6 +79,7 @@ def test_sp_extraction_matches(runners):
     np.testing.assert_allclose(acts_a, acts_b, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # ~1.8k-char prefill compile; CI paged/sp slow step runs it
 def test_sp_long_context_smoke(runners):
     """A long (multi-shard, unaligned) prompt generates identically with
     sequence-parallel prefill — the long-context grader use case."""

@@ -1,0 +1,205 @@
+"""Adaptive speculation controller: pure-host decision logic plus the
+no-recompile contract of bucketed dispatch.
+
+The controller is deliberately model-free — per-cell EWMAs of measured
+acceptance drive bucket scores through a closed-form expected-emitted
+model — so this whole file runs without JAX. The model-backed half of
+the contract (adaptation switches between executables each compiled
+ONCE for their static ``(rounds, k, draft_layers, width)`` signature;
+a second identical run adds zero cache entries) is
+tests/test_speculative.py::test_adaptation_never_recompiles.
+"""
+
+import pytest
+
+from introspective_awareness_tpu.runtime.spec_control import (
+    AUTO_K_MAX,
+    SpecBucket,
+    SpecController,
+    default_buckets,
+    parse_speculate_k,
+    spec_cell_key,
+)
+
+
+# --------------------------------------------------------------------- #
+# parsing + bucket sets                                                 #
+# --------------------------------------------------------------------- #
+
+
+def test_parse_speculate_k():
+    assert parse_speculate_k(0) == (False, 0)
+    assert parse_speculate_k(3) == (False, 3)
+    assert parse_speculate_k("4") == (False, 4)
+    assert parse_speculate_k("auto") == (True, 0)
+    assert parse_speculate_k(" AUTO ") == (True, 0)
+    with pytest.raises(ValueError):
+        parse_speculate_k("fast")
+    with pytest.raises(ValueError):
+        parse_speculate_k(-1)
+
+
+def test_default_buckets_linear_plus_wide():
+    bs = default_buckets(4, 2, n_layers=4)
+    assert [b.k for b in bs] == [1, 2, 3, 4, 4]
+    assert [b.width for b in bs] == [1, 1, 1, 1, 2]
+    # every label unique and stable (manifest keys)
+    assert len({b.label() for b in bs}) == len(bs)
+    # k_max=1 has no room for a tree bucket
+    assert all(b.width == 1 for b in default_buckets(1, 2, n_layers=4))
+
+
+def test_temperature_drops_wide_buckets():
+    bs = default_buckets(4, 2, n_layers=4)
+    ctl = SpecController(bs, n_layers=4, temperature=0.7)
+    assert all(b.width == 1 for b in ctl.buckets)
+    ctl0 = SpecController(bs, n_layers=4, temperature=0.0)
+    assert any(b.width > 1 for b in ctl0.buckets)
+
+
+def test_bucket_validation():
+    with pytest.raises(ValueError):
+        SpecController([], n_layers=4)
+    with pytest.raises(ValueError):
+        SpecController([SpecBucket(2, 4, 1)], n_layers=4)  # dl == n_layers
+    with pytest.raises(ValueError):
+        SpecController(
+            [SpecBucket(2, 2, 1), SpecBucket(2, 2, 1)], n_layers=4
+        )
+
+
+def test_spec_cell_key():
+    class T:
+        steer_layer = 2
+        steer_strength = 4.0
+
+    assert spec_cell_key(T()) == "L2|s4"
+
+
+# --------------------------------------------------------------------- #
+# EWMA convergence -> bucket choice                                     #
+# --------------------------------------------------------------------- #
+
+
+def _drive(ctl, cell, rate, n=30, drafted=12):
+    for _ in range(n):
+        ctl.observe(cell, int(round(rate * drafted)), drafted)
+
+
+def test_ewma_tracks_observations():
+    ctl = SpecController(default_buckets(4, 2, 4), n_layers=4)
+    _drive(ctl, "c", 0.25)
+    assert abs(ctl.rate("c") - 0.25) < 0.05
+    _drive(ctl, "c", 0.9)
+    assert abs(ctl.rate("c") - 0.9) < 0.05
+
+
+def test_low_acceptance_converges_to_k1():
+    ctl = SpecController(default_buckets(4, 2, 4), n_layers=4)
+    _drive(ctl, "c", 0.02)
+    for g in range(6):
+        b = ctl.choose({"c": 4}, chunk=g)
+    assert b.k == 1 and b.width == 1
+
+
+def test_acceptance_regime_shift_adapts():
+    """A live regime change (drafter suddenly blind to the injection, say)
+    must move the incumbent: deep while acceptance is high, back to k=1
+    once the EWMA absorbs a collapse."""
+    ctl = SpecController(default_buckets(4, 2, 4), n_layers=4)
+    _drive(ctl, "c", 0.95, drafted=100)
+    hi = ctl.choose({"c": 4}, chunk=0)
+    assert hi.k >= 3
+    _drive(ctl, "c", 0.02)
+    lo = ctl.choose({"c": 4}, chunk=1)
+    assert lo.k == 1
+    assert ctl.adaptations >= 1
+
+
+def test_high_acceptance_converges_to_deep():
+    ctl = SpecController(default_buckets(4, 2, 4), n_layers=4)
+    _drive(ctl, "c", 0.97)
+    for g in range(6):
+        b = ctl.choose({"c": 4}, chunk=g)
+    assert b.k == AUTO_K_MAX
+
+
+def test_hysteresis_prevents_thrash():
+    ctl = SpecController(default_buckets(4, 2, 4), n_layers=4)
+    _drive(ctl, "c", 0.5)
+    first = ctl.choose({"c": 4}, chunk=0)
+    # jitter the EWMA slightly around 0.5: the incumbent must hold unless
+    # a challenger clears the relative margin
+    switches = 0
+    for g, r in enumerate([0.52, 0.48, 0.51, 0.49, 0.5, 0.53, 0.47]):
+        ctl.observe("c", int(round(r * 100)), 100)
+        b = ctl.choose({"c": 4}, chunk=g + 1)
+        switches += int(b != first)
+        first = b
+    assert switches == 0
+
+
+def test_policy_biases_interactive_narrow_bulk_wide():
+    bs = default_buckets(4, 2, 4)
+
+    def pol(cell):
+        return cell.split("|", 1)[0]
+
+    inter = SpecController(bs, n_layers=4, cell_policy=pol)
+    bulk = SpecController(bs, n_layers=4, cell_policy=pol)
+    # mid-acceptance regime where wide vs deep is genuinely contested
+    _drive(inter, "interactive|L2|s4", 0.75)
+    _drive(bulk, "bulk|L2|s4", 0.75)
+    for g in range(4):
+        bi = inter.choose({"interactive|L2|s4": 4}, chunk=g)
+        bb = bulk.choose({"bulk|L2|s4": 4}, chunk=g)
+    assert bi.width == 1  # interactive -> deep/narrow
+    wide = SpecBucket(4, 2, 2)
+    # bulk tolerates the tree: its wide score must beat interactive's
+    assert bulk.score(wide, {"bulk|L2|s4": 4}) > inter.score(
+        wide, {"interactive|L2|s4": 4}
+    )
+
+
+def test_unknown_cells_use_optimistic_init():
+    ctl = SpecController(default_buckets(4, 2, 4), n_layers=4)
+    b = ctl.choose({"never-seen": 2}, chunk=0)
+    assert b.k == AUTO_K_MAX  # init_rate=1.0 -> speculate hard until data
+
+
+# --------------------------------------------------------------------- #
+# journal + snapshot                                                    #
+# --------------------------------------------------------------------- #
+
+
+def test_every_decision_journaled_with_cap():
+    ctl = SpecController(
+        default_buckets(2, 1, 4), n_layers=4, journal_cap=5
+    )
+    for g in range(8):
+        ctl.choose({"c": 1}, chunk=g)
+    snap = ctl.snapshot()
+    assert snap["decisions"] == 8
+    assert len(snap["journal"]) == 5
+    assert snap["journal_dropped"] == 3
+    e = snap["journal"][0]
+    for key in ("decision", "bucket", "k", "width", "draft_layers",
+                "switched", "scores", "chunk"):
+        assert key in e
+    assert set(snap["buckets"]) == {b.label() for b in ctl.buckets}
+
+
+def test_calibration_folds_measured_tps():
+    ctl = SpecController(default_buckets(2, 1, 4), n_layers=4)
+    b = ctl.buckets[0]
+    ctl.observe("c", 1, 2, emitted=8, wall_s=0.5, bucket=b)
+    snap = ctl.snapshot()
+    assert b.label() in snap["calibration"]
+    assert snap["calibration"][b.label()] > 0.0
+
+
+# The model-backed no-recompile probe (a second identical adaptive run
+# must add ZERO speculative-executable cache entries) lives in
+# tests/test_speculative.py::test_adaptation_never_recompiles, sharing
+# its module-scoped auto_flow fixture so tier-1 pays the tiny model
+# init and 5-bucket precompile exactly once.

@@ -72,17 +72,11 @@ def _queue(n, hidden, lo_layer=1, hi_layer=3):
     return prompts, layers, vecs, strengths, starts
 
 
-@pytest.mark.parametrize("k", [1, 2, 4])
-# D=1 is the degenerate all-above-cut column (acceptance ~0 everywhere);
-# D=3 already exercises steering below AND above the cut, so D=1 rides slow.
-@pytest.mark.parametrize(
-    "draft_layers", [pytest.param(1, marks=pytest.mark.slow), 3]
-)
-def test_greedy_bit_identity(runner, k, draft_layers):
-    """temp 0: speculation is an execution detail — text must be
-    bit-identical to the plain scheduler for every (k, D), with the queue
-    mixing steer layers below (high acceptance) and above (near-zero
-    acceptance) the draft cut."""
+@pytest.fixture(scope="module")
+def greedy6(runner):
+    """The shared 6-trial greedy queue + its ONE non-speculative reference
+    run — every linear-k and tree bit-identity anchor below compares
+    against this instead of re-decoding the baseline per param."""
     prompts, layers, vecs, strengths, starts = _queue(6, runner.cfg.hidden_size)
     kw = dict(
         max_new_tokens=12, temperature=0.0,
@@ -91,6 +85,21 @@ def test_greedy_bit_identity(runner, k, draft_layers):
     base = runner.generate_grid_scheduled(
         prompts, layers, vecs, strengths, **kw
     )
+    return prompts, layers, vecs, strengths, kw, base
+
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+# D=1 is the degenerate all-above-cut column (acceptance ~0 everywhere);
+# D=3 already exercises steering below AND above the cut, so D=1 rides slow.
+@pytest.mark.parametrize(
+    "draft_layers", [pytest.param(1, marks=pytest.mark.slow), 3]
+)
+def test_greedy_bit_identity(runner, greedy6, k, draft_layers):
+    """temp 0: speculation is an execution detail — text must be
+    bit-identical to the plain scheduler for every (k, D), with the queue
+    mixing steer layers below (high acceptance) and above (near-zero
+    acceptance) the draft cut."""
+    prompts, layers, vecs, strengths, kw, base = greedy6
     spec = runner.generate_grid_scheduled(
         prompts, layers, vecs, strengths,
         speculate_k=k, draft_layers=draft_layers, **kw
@@ -217,3 +226,138 @@ def test_no_shared_prefix_falls_back_and_ledgers(setup):
         e.get("name") == "speculation_unavailable_fallback"
         for e in ledger.events
     )
+
+
+# --------------------------------------------------------------------- #
+# tree drafting (width > 1) + adaptive controller                       #
+# --------------------------------------------------------------------- #
+
+# Tier-1 anchors at width {1, 2} x depth {2, 3}; the wider/deeper matrix
+# (and the degenerate all-above-cut D=1 column) rides the slow lane with
+# the kernel-interpret sweep.
+_TREE_GRID = [
+    (1, 2, 3), (2, 2, 3), (1, 3, 3), (2, 3, 3),
+    pytest.param(2, 4, 3, marks=pytest.mark.slow),
+    pytest.param(3, 4, 3, marks=pytest.mark.slow),
+    pytest.param(2, 2, 1, marks=pytest.mark.slow),
+    pytest.param(3, 3, 1, marks=pytest.mark.slow),
+]
+
+
+@pytest.mark.parametrize("width,k,draft_layers", _TREE_GRID)
+def test_tree_greedy_bit_identity(runner, greedy6, width, k, draft_layers):
+    """temp 0 with a width x k token tree verified in ONE full-depth
+    launch: accepting the longest root-to-leaf matching path must stay
+    bit-identical to the plain scheduler — the single-bucket controller
+    forces every chunk onto the (k, D, width) tree executable."""
+    prompts, layers, vecs, strengths, kw, base = greedy6
+    tree = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths,
+        speculate_k=k, draft_layers=draft_layers,
+        spec_buckets=[(k, draft_layers, width)], **kw
+    )
+    assert tree == base
+
+
+def test_tree_budget_clamp_bit_identity(runner):
+    """Budgets that straddle tree-round boundaries clamp candidates
+    mid-round exactly like the linear path."""
+    N = 6
+    prompts, layers, vecs, strengths, starts = _queue(N, runner.cfg.hidden_size)
+    budgets = [3, 10, 6, 2, 9, 5]
+    kw = dict(
+        max_new_tokens=11, temperature=0.0,
+        steering_start_positions=starts, budgets=budgets, seed=0, slots=3,
+    )
+    base = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, **kw
+    )
+    tree = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths,
+        speculate_k=3, draft_layers=3, spec_buckets=[(3, 3, 2)], **kw
+    )
+    assert tree == base
+
+
+def _spec_cache_sizes():
+    from introspective_awareness_tpu.runtime import generate, paged
+
+    return (
+        generate.scheduler_decode_chunk_speculate._cache_size()
+        + paged.paged_decode_chunk_speculate._cache_size()
+        + paged.paged_decode_chunk_speculate_pallas._cache_size()
+    )
+
+
+@pytest.fixture(scope="module")
+def auto_flow(runner):
+    """One shared base + two identical ``--speculate-k auto`` runs, with
+    speculative-executable compile-cache probes around the second — the
+    auto-mode tests below all assert off this single (expensive, 5-bucket
+    precompile) flow."""
+    prompts, layers, vecs, strengths, starts = _queue(8, runner.cfg.hidden_size)
+    kw = dict(
+        max_new_tokens=16, temperature=0.0,
+        steering_start_positions=starts, seed=0, slots=3,
+    )
+    base = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, **kw
+    )
+    auto1 = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, speculate_k="auto", **kw
+    )
+    sc = runner.last_spec_control
+    warm = _spec_cache_sizes()
+    auto2 = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, speculate_k="auto", **kw
+    )
+    return dict(base=base, auto1=auto1, auto2=auto2, sc=sc,
+                warm=warm, after=_spec_cache_sizes())
+
+
+def test_auto_adaptive_bit_identity_and_journal(auto_flow):
+    """--speculate-k auto: whatever bucket walk the controller takes,
+    greedy text stays bit-identical, and every per-chunk decision lands
+    in the journal the manifest embeds (runner.last_spec_control)."""
+    assert auto_flow["auto1"] == auto_flow["base"]
+    sc = auto_flow["sc"]
+    assert sc is not None and sc["decisions"] >= 1
+    assert len(sc["journal"]) == sc["decisions"]
+    for e in sc["journal"]:
+        assert e["bucket"] in sc["buckets"]
+        assert e["k"] >= 1 and e["width"] >= 1
+    # per-cell acceptance EWMAs attributed by steering cell
+    assert sc["cells"] and all("|" in c or c == "" for c in sc["cells"])
+
+
+def test_adaptation_never_recompiles(auto_flow):
+    """Every bucket the controller can pick maps to an executable cached
+    on its static ``(rounds, k, draft_layers, width)`` signature (the
+    scheduler pre-compiles the whole set up front); a second identical
+    adaptive run must therefore add ZERO speculative-decode cache
+    entries, whatever sequence of buckets the controller walks — and,
+    same seed, produce the same text."""
+    assert auto_flow["warm"] >= 1  # the auto run really used a spec tier
+    assert auto_flow["after"] == auto_flow["warm"]
+    assert auto_flow["auto2"] == auto_flow["auto1"]
+
+
+def test_auto_sampled_reproducible_and_narrow(runner):
+    """temp > 0 in auto mode: wide buckets are dropped (rejection sampling
+    resolves on the first chain only), and the same seed must reproduce
+    the same draws across runs of the adaptive controller."""
+    prompts, layers, vecs, strengths, starts = _queue(6, runner.cfg.hidden_size)
+    kw = dict(
+        max_new_tokens=10, temperature=0.9,
+        steering_start_positions=starts, seed=11, slots=3,
+        speculate_k="auto",
+    )
+    one = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, **kw
+    )
+    sc = runner.last_spec_control
+    assert all("w1" in b for b in sc["buckets"])
+    two = runner.generate_grid_scheduled(
+        prompts, layers, vecs, strengths, **kw
+    )
+    assert one == two
