@@ -207,6 +207,19 @@ def build_parser() -> argparse.ArgumentParser:
                              "on-device = co-resident JAX grader; none = keyword only")
     parser.add_argument("--judge-model", type=str, default="gpt-4.1-nano",
                         help="Judge model: API name, checkpoint dir, or tiny[:seed]")
+    parser.add_argument("--judge-dispatch", type=str, default="co-scheduled",
+                        choices=["co-scheduled", "fixed-batch"],
+                        help="on-device judge dispatch: co-scheduled = grading "
+                             "prompts are bulk tenants of a persistent paged "
+                             "scheduler (pinned rubric pages, overlap-safe "
+                             "streaming grading); fixed-batch = reference "
+                             "generate_batch path, serialized against decode")
+    parser.add_argument("--judge-slots", type=int, default=8,
+                        help="decode slots for the co-scheduled judge loop")
+    parser.add_argument("--judge-max-prompt-len", type=int, default=2048,
+                        help="max grading-prompt tokens the co-scheduled judge "
+                             "admits (sizes its page geometry; longer prompts "
+                             "grade as ERROR rows)")
     parser.add_argument("--attn-impl", type=str, default="xla",
                         choices=["xla", "flash", "flash_cached"],
                         help="Attention implementation: fused einsum (xla), "

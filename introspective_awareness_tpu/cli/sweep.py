@@ -103,6 +103,7 @@ def _build_judge(args, mesh, rules):
         LLMJudge,
         OnDeviceJudgeClient,
         OpenAIJudgeClient,
+        ScheduledJudgeClient,
     )
 
     if args.judge_backend == "none":
@@ -111,11 +112,25 @@ def _build_judge(args, mesh, rules):
         grader = load_subject(args.judge_model, args, mesh, rules)
         meter = getattr(args, "_roofline", None)
         if meter is not None:
-            # Judge decodes ride the fixed-batch path; prefix the rows so
-            # the roofline block separates grader cost from subject cost.
+            # Prefix the grader's roofline rows so its cost separates from
+            # subject cost in the attribution plane.
             grader.roofline = meter
             grader.roofline_prefix = "judge_"
-        return LLMJudge(client=OnDeviceJudgeClient(grader, max_tokens=500))
+        if getattr(args, "judge_dispatch", "co-scheduled") == "fixed-batch":
+            # Reference dispatch: one generate_batch per grading chunk,
+            # serialized against subject decode (overlap_safe=False).
+            return LLMJudge(client=OnDeviceJudgeClient(grader, max_tokens=500))
+        # Co-scheduled dispatch: grading prompts become bulk paged-scheduler
+        # tenants on the grader (pinned rubric pages, judge| spec cells,
+        # stop-string harvest) — overlap_safe, so streaming grading overlaps
+        # subject decode. Closed in the sweep's teardown.
+        return LLMJudge(client=ScheduledJudgeClient(
+            grader, max_tokens=500,
+            slots=int(getattr(args, "judge_slots", 8) or 8),
+            max_prompt_len=int(
+                getattr(args, "judge_max_prompt_len", 2048) or 2048),
+            speculate_k=getattr(args, "speculate_k", 0),
+        ))
     try:
         return LLMJudge(client=OpenAIJudgeClient(model=args.judge_model))
     except (ValueError, ImportError) as e:
@@ -524,8 +539,9 @@ def run_sweep(args, runner, judge, model_name: str) -> dict:
     # Stream finished trials into judge grading while decode continues: the
     # pipelined scheduler surfaces each trial the moment it finalizes, and a
     # bounded worker pool grades concurrently — but only for clients that can
-    # safely run off-thread during decode (the on-device grader shares the
-    # subject's chips and opts out via overlap_safe=False).
+    # safely run off-thread during decode. The co-scheduled on-device judge
+    # qualifies (its workers only enqueue into the judge scheduler thread);
+    # the fixed-batch on-device grader opts out via overlap_safe=False.
     stream_grading = (
         judge is not None
         and args.scheduler == "continuous"
@@ -1314,6 +1330,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     try:
         return _run_models(args, models, judge, ledger, mesh, rules)
     finally:
+        # A co-scheduled judge owns a live scheduler thread (and the rubric
+        # pins inside it); drain it before tearing the telemetry plane down.
+        jc = getattr(judge, "client", None)
+        if hasattr(jc, "close"):
+            try:
+                jc.close()
+            except Exception as e:  # noqa: BLE001 - teardown best-effort
+                print(f"note: judge client close failed: {e}")
         if metrics_server is not None:
             metrics_server.stop()
         if args._trace is not None and args._trace.n_recorded:

@@ -24,6 +24,7 @@ from introspective_awareness_tpu.judge.client import (
     JudgeClient,
     OnDeviceJudgeClient,
     OpenAIJudgeClient,
+    ScheduledJudgeClient,
     load_dotenv,
 )
 from introspective_awareness_tpu.judge.parsers import parse_grade, parse_yes_no
@@ -48,6 +49,7 @@ __all__ = [
     "JudgeClient",
     "OnDeviceJudgeClient",
     "OpenAIJudgeClient",
+    "ScheduledJudgeClient",
     "load_dotenv",
     "parse_grade",
     "parse_yes_no",

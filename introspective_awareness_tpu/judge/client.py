@@ -1,9 +1,24 @@
-"""Judge backends: OpenAI-compatible async client + on-device TPU grader.
+"""Judge backends: OpenAI-compatible async client + on-device TPU graders.
 
 ``JudgeClient`` is the one-method seam between the grading flow and whatever
 answers grading prompts: the OpenAI API (reference behavior,
 eval_utils.py:236-404), a co-resident JAX model on the TPU mesh
 (BASELINE.json "no GPU in the loop" configuration), or a test fake.
+
+Two on-device backends share the co-resident grader model:
+
+- ``OnDeviceJudgeClient`` — the fixed-batch reference path: one
+  ``generate_batch`` per grading chunk, serialized against subject decode
+  (``overlap_safe = False``).
+- ``ScheduledJudgeClient`` — grading as a first-class scheduler tenant: a
+  persistent feed-mode ``run_scheduled_paged`` loop on the grader runner
+  admits every grading prompt as a bulk-priority paged trial (continuous
+  batching, radix prefix sharing with the rubric pages PINNED, speculative
+  decode under ``judge|``-prefixed controller cells, per-slot stop-string
+  harvest). All device dispatch happens on the one scheduler thread, so
+  concurrent ``grade()`` calls from streaming-grade workers only enqueue —
+  the client is ``overlap_safe = True`` and byte-identical (greedy) to the
+  fixed-batch path.
 """
 
 from __future__ import annotations
@@ -11,7 +26,24 @@ from __future__ import annotations
 import asyncio
 import os
 import random
+import threading
+from collections import deque
 from typing import Optional, Protocol, Sequence
+
+import numpy as np
+
+from introspective_awareness_tpu.runtime.scheduler import (
+    PagedTrial,
+    SchedulerFeed,
+    run_scheduled_paged,
+)
+from introspective_awareness_tpu.runtime.spec_control import (
+    AUTO_K_MAX,
+    SpecController,
+    default_buckets,
+    parse_speculate_k,
+    spec_cell_key,
+)
 
 
 def _retry_after_seconds(exc: Exception) -> Optional[float]:
@@ -200,7 +232,8 @@ class OnDeviceJudgeClient:
     # Grading generates on the SAME chips the subject's scheduler is
     # driving — streaming it concurrently with decode would contend for the
     # device (and call jit from a second thread mid-dispatch). The
-    # streaming grade pool must not be built around this client.
+    # streaming grade pool must not be built around this client; use
+    # ScheduledJudgeClient for overlap-safe on-device grading.
     overlap_safe = False
     # criteria.render("prefix-cached"): the whole (verbatim) criteria text
     # becomes a shared token prefix, so the runner's shared-prefix KV cache
@@ -244,3 +277,296 @@ class OnDeviceJudgeClient:
             except Exception as e:  # noqa: BLE001 - contract: ERROR: strings
                 out.extend([f"ERROR: {e}"] * n)
         return out
+
+
+class ScheduledJudgeClient(SchedulerFeed):
+    """Grade through the continuous paged scheduler — judge trials are
+    first-class tenants of the same machinery that decodes subjects.
+
+    One lazily started scheduler thread runs a feed-mode
+    ``run_scheduled_paged`` loop over the grader's params for the life of
+    the client. ``grade()`` renders each prompt exactly like the
+    fixed-batch client (chat template, greedy, the same stop strings),
+    tokenizes it, and enqueues it as an unsteered ``PagedTrial``; results
+    come back through ``result_cb`` and are decoded with the runner's own
+    ``_decode_row``, so greedy verdicts are byte-identical to
+    ``OnDeviceJudgeClient`` (tests/test_judge_scheduled.py pins this).
+
+    Why this is ``overlap_safe``: every jit dispatch against the grader
+    happens on the one scheduler thread. ``grade()`` callers — including
+    concurrent ``StreamingGradePool`` workers racing the subject's decode —
+    only append to a host-side queue and wait on a condition variable, so
+    on-device grading finally overlaps subject decode instead of
+    serializing against it.
+
+    Copy-free rubric prefix: under ``prompt_order="prefix-cached"`` every
+    grading prompt of a batch opens with the verbatim criteria text. The
+    client computes the batch's longest common token prefix and asks the
+    loop to PIN those radix pages (``SchedulerFeed.take_pins``): after the
+    first row prefills them, admission of every later grade row — across
+    batches and both grading stages — radix-hits the rubric and becomes a
+    FLOP-free page-table edit that LRU pressure can never undo. Pins are
+    released when the loop exits at ``close()``.
+
+    Speculation: pass ``speculate_k`` (int or ``"auto"``) to draft judge
+    tokens too — grading responses are short, templated, high-acceptance
+    text. Judge trials get their own ``judge|L{l}|s{s}`` controller cells
+    (the PR 18 priority-joined key shape), biased like bulk tenants
+    (wide trees) when the adaptive controller runs.
+    """
+
+    STOP_STRINGS = OnDeviceJudgeClient.STOP_STRINGS
+    overlap_safe = True
+    preferred_prompt_order = "prefix-cached"
+
+    def __init__(
+        self,
+        runner,
+        max_tokens: int = 500,
+        *,
+        slots: int = 8,
+        max_prompt_len: int = 2048,
+        seed: int = 0,
+        speculate_k=0,
+        draft_layers: Optional[int] = None,
+        pin_rubric: bool = True,
+    ):
+        self.runner = runner
+        self.model_name = f"on-device-scheduled:{runner.model_name}"
+        self.max_tokens = int(max_tokens)
+        self.slots = int(slots)
+        self.max_prompt_len = int(max_prompt_len)
+        self.seed = int(seed)
+        self.pin_rubric = bool(pin_rubric)
+        self._spec_auto, self.speculate_k = parse_speculate_k(speculate_k)
+        if self._spec_auto:
+            self.speculate_k = min(AUTO_K_MAX, max(1, self.max_tokens - 1))
+        self.draft_layers = draft_layers
+        self.spec_control = None
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._results: dict[int, str] = {}
+        self._pins: deque = deque()
+        self._pinned_prefixes: set[tuple[int, ...]] = set()
+        self._next_stream = 0
+        self._accepting = True
+        self._thread: Optional[threading.Thread] = None
+        self._loop_error: Optional[BaseException] = None
+        self.stats: dict = {}
+
+    # -- SchedulerFeed (scheduler thread) -----------------------------------
+
+    def pull(self, k: int) -> list:
+        out: list = []
+        with self._lock:
+            while len(out) < k and self._queue:
+                out.append(self._queue.popleft())
+        return out
+
+    def open(self) -> bool:
+        return self._accepting
+
+    def take_pins(self) -> list:
+        with self._lock:
+            out = list(self._pins)
+            self._pins.clear()
+        return out
+
+    def _on_result(self, sid: int, toks: np.ndarray) -> None:
+        # Host-only work (token -> text); safe on the scheduler thread.
+        text = self.runner._decode_row(np.asarray(toks))
+        with self._cv:
+            self._results[int(sid)] = text
+            self._cv.notify_all()
+
+    # -- speculation policy -------------------------------------------------
+
+    def _spec_cell(self, trial) -> str:
+        return f"judge|{spec_cell_key(trial)}"
+
+    @staticmethod
+    def _spec_policy(cell: str) -> Optional[str]:
+        # Grading is throughput work: bias judge cells like bulk tenants
+        # (wide trees); unknown prefixes stay neutral.
+        return "bulk" if cell.split("|", 1)[0] == "judge" else None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ScheduledJudgeClient":
+        """Idempotently start the grading scheduler thread."""
+        if self._thread is not None:
+            return self
+        if not self._accepting:
+            raise RuntimeError("ScheduledJudgeClient is closed")
+        r = self.runner
+        spec_k = int(self.speculate_k)
+        dl = None
+        spec_control = None
+        spec_cell_of = None
+        if spec_k:
+            nl = int(r.cfg.n_layers)
+            dl = (int(self.draft_layers) if self.draft_layers
+                  else max(1, nl // 2))
+            if not (0 < dl < nl):
+                raise ValueError(
+                    f"draft_layers={dl} must be in (0, {nl}) for "
+                    f"self-speculative judging")
+            if self._spec_auto:
+                spec_control = SpecController(
+                    default_buckets(spec_k, dl, nl),
+                    n_layers=nl,
+                    temperature=0.0,
+                    cell_policy=self._spec_policy,
+                )
+            spec_cell_of = self._spec_cell
+        self.spec_control = spec_control
+        stop = np.asarray(r._stop_token_seqs(self.STOP_STRINGS))
+
+        def _loop() -> None:
+            try:
+                _, self.stats = run_scheduled_paged(
+                    r.params, r.cfg, [],
+                    slots=self.slots,
+                    max_new_tokens=self.max_tokens,
+                    page_size=r.kv_page_size,
+                    temperature=0.0,  # reference judge temp (eval_utils:244)
+                    eos_ids=list(r.tokenizer.eos_ids),
+                    pad_id=int(r.tokenizer.pad_id),
+                    stop_seqs=stop,
+                    seed=self.seed,
+                    ledger=r.ledger,
+                    pipeline=True,
+                    result_cb=self._on_result,
+                    feed=self,
+                    max_prompt_len=self.max_prompt_len,
+                    replica="judge",
+                    roofline=getattr(r, "roofline", None),
+                    decode_kernel=getattr(r, "decode_kernel", "xla"),
+                    speculate_k=spec_k,
+                    draft_layers=dl,
+                    spec_control=spec_control,
+                    spec_cell_of=spec_cell_of,
+                )
+            except BaseException as e:  # noqa: BLE001 — surfaced to waiters
+                self._loop_error = e
+                r.ledger.event("judge_loop_crashed", error=repr(e))
+                with self._cv:
+                    self._cv.notify_all()
+
+        self._thread = threading.Thread(
+            target=_loop, name="judge-scheduler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 120.0) -> dict:
+        """Graceful drain: in-flight grade rows finish, the loop exits
+        (releasing the rubric pins with it), the thread joins. Returns the
+        loop stats. Idempotent."""
+        with self._cv:
+            self._accepting = False
+            self._cv.notify_all()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=timeout)
+        if self._loop_error is not None:
+            raise RuntimeError(
+                "judge scheduler crashed"
+            ) from self._loop_error
+        return dict(self.stats)
+
+    # -- grading ------------------------------------------------------------
+
+    def _register_pin(self, rows: list[np.ndarray]) -> None:
+        """Queue the batch's longest common PAGE-ALIGNED token prefix for
+        pinning (caller holds the lock). Under prefix-cached prompt order
+        that prefix is the chat-template head + the verbatim rubric text.
+        Deduped: a prefix already covered by a queued/standing pin is
+        skipped, so re-grading with the same rubric pins nothing new."""
+        if len(rows) < 2:
+            return  # a single row's "common prefix" is the whole prompt
+        first = rows[0]
+        lcp = min(int(r.shape[0]) for r in rows)
+        for r in rows[1:]:
+            neq = np.nonzero(first[:lcp] != r[:lcp])[0]
+            if neq.size:
+                lcp = int(neq[0])
+        pg = int(self.runner.kv_page_size)
+        n = (lcp // pg) * pg
+        if n < pg:
+            return
+        pfx = tuple(int(t) for t in first[:n])
+        if any(len(p) >= n and p[:n] == pfx for p in self._pinned_prefixes):
+            return
+        self._pinned_prefixes.add(pfx)
+        self._pins.append(list(pfx))
+
+    def grade(self, prompts: Sequence[str]) -> list[str]:
+        if not prompts:
+            return []
+        try:
+            self.start()
+        except Exception as e:  # noqa: BLE001 - contract: ERROR: strings
+            return [f"ERROR: {e}"] * len(prompts)
+        tok = self.runner.tokenizer
+        zero_vec = np.zeros(
+            (int(self.runner.cfg.hidden_size),), np.float32
+        )
+        rendered = [
+            tok.apply_chat_template(
+                [{"role": "user", "content": p}], add_generation_prompt=True
+            )
+            for p in prompts
+        ]
+        rows = [np.asarray(tok.encode(s), np.int32) for s in rendered]
+        out: list[Optional[str]] = [None] * len(prompts)
+        submits: list[tuple[int, PagedTrial]] = []
+        for i, row in enumerate(rows):
+            plen = int(row.shape[0])
+            if not (1 <= plen <= self.max_prompt_len):
+                out[i] = (
+                    f"ERROR: grading prompt is {plen} tokens; the "
+                    f"scheduled judge accepts 1..{self.max_prompt_len}"
+                )
+                continue
+            submits.append((i, PagedTrial(
+                prompt_ids=row,
+                steer_layer=0,
+                steer_strength=0.0,
+                steer_vector=zero_vec,
+                steer_start=0,
+                budget=self.max_tokens,
+            )))
+        if not submits:
+            return [t if t is not None else "ERROR: not graded"
+                    for t in out]
+        sids: dict[int, int] = {}
+        with self._cv:
+            if not self._accepting:
+                return [
+                    t if t is not None else "ERROR: judge client is closed"
+                    for t in out
+                ]
+            if self.pin_rubric:
+                self._register_pin([rows[i] for i, _ in submits])
+            for i, trial in submits:
+                sid = self._next_stream
+                self._next_stream += 1
+                sids[sid] = i
+                self._queue.append((sid, trial))
+            pending = set(sids)
+            while pending:
+                done = [s for s in pending if s in self._results]
+                for s in done:
+                    out[sids[s]] = self._results.pop(s)
+                    pending.discard(s)
+                if not pending:
+                    break
+                if self._loop_error is not None:
+                    for s in pending:
+                        out[sids[s]] = f"ERROR: {self._loop_error}"
+                    break
+                if not done:
+                    self._cv.wait(timeout=0.05)
+        return [t if t is not None else "ERROR: not graded" for t in out]

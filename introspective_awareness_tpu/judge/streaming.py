@@ -17,10 +17,17 @@ Threading contract:
   one ``grading_overlap`` event from its own thread instead.
 - ``OpenAIJudgeClient.grade`` spins a fresh event loop + client per batch,
   so concurrent calls from worker threads are independent.
-- ``OnDeviceJudgeClient`` is *not* overlap-safe: it generates on the same
-  chips (and jit machinery) the scheduler is driving. It carries
-  ``overlap_safe = False`` and callers must not build a pool around it —
-  check ``getattr(judge.client, "overlap_safe", True)``.
+- ``ScheduledJudgeClient`` is overlap-safe ON-DEVICE: its ``grade`` only
+  enqueues grading prompts into a persistent feed-mode paged scheduler on
+  the grader model and waits on a condition variable — every jit dispatch
+  happens on that one scheduler thread, never on the pool's workers, so
+  on-device grading finally overlaps subject decode.
+- The fixed-batch ``OnDeviceJudgeClient`` is *not* overlap-safe: it calls
+  ``generate_batch`` on the worker thread, contending with the subject's
+  scheduler for the chips (and calling jit from a second thread
+  mid-dispatch). It carries ``overlap_safe = False`` and callers must not
+  build a pool around it — check
+  ``getattr(judge.client, "overlap_safe", True)``.
 - A worker failure (API down, parse explosion) is retried inline up to
   ``max_attempts`` times, then the batch is *deferred*: recorded in the
   trial journal's deferred-grading queue (when a journal is attached) and

@@ -97,6 +97,13 @@ def _serving_goodput(doc: dict) -> Optional[float]:
     return srv.get("serving_goodput_evals_per_s")
 
 
+def _ondevice_grading(doc: dict) -> Optional[float]:
+    sec = doc.get("ondevice_grading") or {}
+    if sec.get("skipped"):
+        return None
+    return sec.get("evals_per_sec_scheduled")
+
+
 HEADLINES: tuple = (
     ("evals_per_sec_chip", _value, True, 0.10, 0.0),
     ("decode_steps_per_sec", _decode_steps, True, 0.15, 0.0),
@@ -137,6 +144,13 @@ HEADLINES: tuple = (
     # throughput metrics above don't: wide relative tolerance. Rounds
     # predating the section skip, never fail.
     ("serving_goodput_evals_per_s", _serving_goodput, True, 0.25, 0.0),
+    # Co-scheduled on-device grading throughput (ScheduledJudgeClient leg
+    # of the bench's "ondevice_grading" A/B, graded under live subject
+    # load). The concurrent subject queue makes this a wall-clock measure
+    # with thread-scheduling jitter, so it gets the wide serving-style
+    # tolerance. History-tolerant: rounds predating the section skip,
+    # never fail.
+    ("ondevice_grading_evals_per_s", _ondevice_grading, True, 0.25, 0.0),
 )
 
 
@@ -321,6 +335,9 @@ def inject_regression(history: list[tuple[Optional[dict], Any]],
     if isinstance(cur.get("adaptive_spec"), dict) and \
             cur["adaptive_spec"].get("adaptive_spec_decode_steps_per_s"):
         cur["adaptive_spec"]["adaptive_spec_decode_steps_per_s"] *= factor
+    if isinstance(cur.get("ondevice_grading"), dict) and \
+            cur["ondevice_grading"].get("evals_per_sec_scheduled"):
+        cur["ondevice_grading"]["evals_per_sec_scheduled"] *= factor
     return cur
 
 

@@ -427,7 +427,17 @@ def run_grid_pass(
             gstats = dict(gstats)
             for d in gstats.pop("degraded", []):
                 ledger.event("grade_degraded", pass_key=pass_key, **d)
-            ledger.event("grading_overlap", trials=N, **gstats)
+            # Client provenance: the overlap fraction only means "grading
+            # genuinely overlapped decode" for overlap-safe clients — the
+            # co-scheduled on-device judge qualifies, the fixed-batch one
+            # never reaches this path.
+            jc = getattr(getattr(grade_pool, "judge", None), "client", None)
+            ledger.event(
+                "grading_overlap", trials=N,
+                judge_client=(
+                    type(jc).__name__ if jc is not None else None),
+                **gstats,
+            )
         out = []
         for i in range(N):
             if i in graded:

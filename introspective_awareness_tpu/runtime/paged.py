@@ -22,7 +22,11 @@ tests/test_paged_kv.py). The gather cost is paid once per chunk
 
 Host-side page accounting (radix tree, refcounts, eviction) lives in
 ``runtime.radix``; the driving loop is
-``runtime.scheduler.run_scheduled_paged``.
+``runtime.scheduler.run_scheduled_paged``. Nothing here knows about pinned
+pages: a pin (``radix.PagePool.pin``) is pure host-side refcounting that
+keeps a page out of eviction — the on-device judge pins its rubric prefix
+this way — while the gathers below read whatever the page tables
+reference, pinned or not.
 """
 
 from __future__ import annotations

@@ -17,6 +17,11 @@ Lifecycle of a prompt page:
 - later trials that radix-hit it ``retain`` it again (share, no copy).
 - ``evict`` (LRU, leaf-only) drops cached pages with refcount 0 back to
   the free list when admission runs out of pages.
+- ``pin`` holds one extra reference on behalf of a long-lived tenant (the
+  on-device judge pins its rubric prefix): a pinned page can never reach
+  refcount 0, so it survives LRU pressure without any special-casing in
+  ``evict``. ``unpin`` drops that reference and the page rejoins the
+  normal cached/LRU lifecycle.
 
 Steered prompts only share their steer-FREE prefix: KV written at or after
 the steering start is contaminated by the injected vector, so the caller
@@ -41,6 +46,7 @@ class PagePool:
         self._free: list[int] = list(range(self.n_pages - 1, -1, -1))
         self.refcount = [0] * self.n_pages
         self.cached = [False] * self.n_pages
+        self.pinned = [False] * self.n_pages
 
     @property
     def free_count(self) -> int:
@@ -54,6 +60,27 @@ class PagePool:
     @property
     def cached_count(self) -> int:
         return sum(self.cached)
+
+    @property
+    def pinned_count(self) -> int:
+        return sum(self.pinned)
+
+    def pin(self, pages: Sequence[int]) -> None:
+        """Hold one extra reference per page on behalf of a pin owner.
+        Idempotent per page: pinning an already-pinned page is a no-op, so
+        callers may re-assert pins without leaking references."""
+        for p in pages:
+            if not self.pinned[p]:
+                self.pinned[p] = True
+                self.refcount[p] += 1
+
+    def unpin(self, pages: Sequence[int]) -> list[int]:
+        """Drop the pin reference; returns pages actually freed (pages
+        whose only keep-alive was the pin and that are not cached)."""
+        to_release = [p for p in pages if self.pinned[p]]
+        for p in to_release:
+            self.pinned[p] = False
+        return self.release(to_release)
 
     def alloc(self, n: int) -> Optional[list[int]]:
         """Pop ``n`` pages, or None (caller evicts and retries). All-or-
@@ -118,6 +145,7 @@ class RadixTree:
         self._root = _Node(-1, None, ())  # type: ignore[arg-type]
         self._clock = 0
         self._n_nodes = 0
+        self._pinned_pages: list[int] = []
 
     def _tick(self) -> int:
         self._clock += 1
@@ -200,6 +228,35 @@ class RadixTree:
             if self.pool.uncache(victim.page):
                 freed += 1
         return freed
+
+    def pin_prefix(
+        self, tokens: Sequence[int], limit_tokens: Optional[int] = None
+    ) -> list[int]:
+        """Pin the cached full-page prefix of ``tokens``: every page on the
+        matched root path takes one pin reference in the pool, making the
+        whole prefix immune to LRU eviction until ``release_pins``.
+
+        Idempotent: re-pinning an already-pinned path adds no references,
+        so callers may re-assert the pin after each admission round (pages
+        are only insertable *after* the first trial carrying the prefix is
+        dispatched, so the first attempts may match partially or not at
+        all). Returns the pages newly pinned by THIS call."""
+        matched = self.lookup(tokens, limit_tokens)
+        fresh = [p for p in matched if not self.pool.pinned[p]]
+        self.pool.pin(fresh)
+        self._pinned_pages.extend(fresh)
+        return fresh
+
+    def release_pins(self) -> list[int]:
+        """Drop every pin this tree holds (pool-close / loop-exit hook).
+        Returns the pages freed outright (unpinned, unreferenced, and not
+        cached)."""
+        pages, self._pinned_pages = self._pinned_pages, []
+        return self.pool.unpin(pages)
+
+    @property
+    def pinned_pages(self) -> list[int]:
+        return list(self._pinned_pages)
 
     @property
     def n_nodes(self) -> int:

@@ -136,6 +136,14 @@ class SchedulerFeed:
       actually-evicted victim through ``on_preempted(stream_id,
       n_streamed)`` (victims that finished while the eviction was in
       flight are NOT confirmed — they completed normally).
+    - ``take_pins()`` returns token prefixes (int sequences) the feed wants
+      pinned in the radix cache for the life of the loop (e.g. a judge's
+      rubric text). The loop re-asserts each pin after every admission
+      wave — pages only become pinnable once some trial carrying the
+      prefix has been admitted and its pages inserted — so a pin request
+      eventually covers the whole cached prefix and the pages can never be
+      LRU-evicted, making every later admission that shares them a
+      FLOP-free page-table edit. Pins are released when the loop exits.
     """
 
     def pull(self, k: int) -> list:
@@ -152,6 +160,9 @@ class SchedulerFeed:
 
     def on_preempted(self, stream_id, n_streamed: int) -> None:
         pass
+
+    def take_pins(self) -> list:
+        return []
 
 
 @jax.jit
@@ -1357,6 +1368,11 @@ def run_scheduled_paged(
     share_hits = 0
     share_misses = 0
     pages_peak = 0
+    # Token prefixes the feed asked to pin (SchedulerFeed.take_pins).
+    # Re-asserted after every admission wave: tree.pin_prefix is
+    # page-idempotent, so the walk is cheap and converges once the whole
+    # prefix is cached.
+    pin_reqs: list[list[int]] = []
     refill_min = max(1, int(refill_frac * B))
     bucket_q = int(suffix_bucket)
     gauges = PipelineGauges()
@@ -1618,6 +1634,13 @@ def run_scheduled_paged(
                 trials[qi].prompt_ids, list(matched) + list(fresh),
                 limit_tokens=insert_cap,
             )
+        for pfx in pin_reqs:
+            newly = tree.pin_prefix(pfx)
+            if newly:
+                ledger.event(
+                    "radix_pages_pinned", pages=len(newly),
+                    total_pinned=int(pool.pinned_count),
+                )
         _pool_gauges()
         next_trial += take
         refills += 1
@@ -1933,6 +1956,9 @@ def run_scheduled_paged(
             victims = feed.take_preemptions()
             if victims:
                 _preempt(victims)
+            for pfx in feed.take_pins():
+                pin_reqs.append([int(x) for x in pfx])
+                tree.pin_prefix(pin_reqs[-1])
             backlog = len(trials) - next_trial
             want = int((slot_trial < 0).sum()) - backlog
             if want > 0:
@@ -2007,10 +2033,12 @@ def run_scheduled_paged(
         "prompt_pool_pages": int(Pp),
         "pages_in_use_peak": int(pages_peak),
         "pages_cached": int(pool.cached_count),
+        "pages_pinned": int(pool.pinned_count),
         "radix_nodes": int(tree.n_nodes),
         "preempted": int(preempted),
         **gauges.as_stats(wall_s, chunks_done),
         **sgauges.as_stats(),
         **pgauges.as_stats(),
     }
+    tree.release_pins()  # loop exit == pool close: pins never outlive it
     return results, stats

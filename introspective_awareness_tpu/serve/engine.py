@@ -244,6 +244,60 @@ class ServeEngine(SchedulerFeed):
         self._c_accepted.inc(priority=req.priority)
         return st
 
+    def grade_texts(
+        self,
+        prompts: list[str],
+        *,
+        max_new_tokens: int = 500,
+        tenant: str = "judge",
+        timeout: float = 600.0,
+    ) -> list[str]:
+        """Grade/extract a batch of plain prompts as BULK tenants of the
+        live engine — the serving-tier face of co-scheduled judging: grading
+        rides the same scheduler loop (and radix cache) as the tenants'
+        decode, preemptable by interactive traffic like any other bulk
+        work. Unsteered (``vector="null"``), engine-global temperature.
+        Failures map to ``"ERROR: ..."`` strings (JudgeClient contract)."""
+        streams: list[tuple[int, Any]] = []
+        out: list[Optional[str]] = [None] * len(prompts)
+        for i, p in enumerate(prompts):
+            with self._lock:
+                rid = f"grade-{self._next_stream}-{zlib.crc32(p.encode('utf-8')) & 0xFFFFFFFF:08x}"
+            req = SteerRequest(
+                rid=rid, tenant=tenant, priority="bulk", prompt=p,
+                vector="null", layer=0, strength=0.0, steer_start=0,
+                max_new_tokens=int(max_new_tokens),
+                temperature=self.temperature, stream=None,
+            )
+            deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    streams.append((i, self.submit(req)))
+                    break
+                except QuotaError as e:
+                    # Bulk grading yields to quota pressure instead of
+                    # failing the row; bounded by the caller's timeout.
+                    if time.monotonic() + e.retry_after_s > deadline:
+                        out[i] = f"ERROR: {e}"
+                        break
+                    time.sleep(e.retry_after_s)
+                except RequestError as e:
+                    out[i] = f"ERROR: {e}"
+                    break
+        for i, st in streams:
+            try:
+                while True:
+                    doc = st.q.get(timeout=timeout)
+                    if "error" in doc:
+                        out[i] = f"ERROR: {doc['error']}"
+                        break
+                    if doc.get("done"):
+                        out[i] = doc["text"]
+                        break
+            except queue.Empty:
+                out[i] = f"ERROR: grading timed out after {timeout}s"
+        return [t if t is not None else "ERROR: not graded" for t in out]
+
     def recover(self) -> int:
         """Re-enqueue accepted-but-unfinished requests from the journal
         (their clients are gone; results land in the journal). Returns

@@ -1,0 +1,257 @@
+"""Co-scheduled on-device grading: judge trials as first-class scheduler
+tenants. Pins (1) verdict bit-identity between ``ScheduledJudgeClient`` and
+the fixed-batch ``OnDeviceJudgeClient`` through BOTH grading stages, (2)
+rubric-page pin semantics at the radix level (survives LRU pressure,
+released on pool close), (3) the client's pin/share gauges end to end,
+(4) sane stats with a live subject queue decoding next to the judge loop,
+and (5) the overlap path through ``StreamingGradePool`` now that the
+on-device judge is ``overlap_safe``."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from introspective_awareness_tpu.judge import (
+    LLMJudge,
+    OnDeviceJudgeClient,
+    ScheduledJudgeClient,
+)
+from introspective_awareness_tpu.judge.criteria import (
+    CLAIMS_DETECTION_CRITERIA,
+    CORRECT_CONCEPT_IDENTIFICATION_CRITERIA,
+)
+from introspective_awareness_tpu.judge.streaming import StreamingGradePool
+from introspective_awareness_tpu.models import (
+    ByteTokenizer,
+    init_params,
+    tiny_config,
+)
+from introspective_awareness_tpu.runtime import ModelRunner
+from introspective_awareness_tpu.runtime.radix import PagePool, RadixTree
+
+
+@pytest.fixture(scope="module")
+def grader():
+    cfg = tiny_config(n_layers=2)
+    params = init_params(cfg, jax.random.key(0))
+    return ModelRunner(
+        params, cfg, ByteTokenizer(), model_name="tiny-judge", seed=0
+    )
+
+
+# Real rendered stage-2 criteria run ~3.8k byte-tokens; 4096 covers both
+# stages with room for the chat template.
+MAX_PROMPT = 4096
+
+
+def _sched(grader, **kw):
+    kw.setdefault("max_tokens", 8)
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_prompt_len", MAX_PROMPT)
+    return ScheduledJudgeClient(grader, **kw)
+
+
+# --- (1) verdict bit-identity, both grading stages ---------------------------
+
+
+def test_verdicts_identical_both_stages(grader):
+    """Greedy verdicts from the co-scheduled judge must be byte-identical
+    to the fixed-batch grader for the REAL stage-1 (claims-detection) and
+    stage-2 (concept-identification) criteria renders — the scheduler,
+    paging, pinning, and stop machinery may not leak into text."""
+    q = "Trial 1: Do you detect an injected thought?"
+    stage1 = [
+        CLAIMS_DETECTION_CRITERIA.render(
+            "prefix-cached", prompt=q,
+            response=f"Response {i}: I notice a pull toward a concept.",
+        )
+        for i in range(3)
+    ]
+    stage2 = [
+        CORRECT_CONCEPT_IDENTIFICATION_CRITERIA.render(
+            "prefix-cached", prompt=q,
+            response=f"Claimer {i}: the injected thought feels like storm.",
+            word="storm",
+        )
+        for i in range(2)
+    ]
+    fixed = OnDeviceJudgeClient(grader, max_tokens=8)
+    sched = _sched(grader)
+    try:
+        for prompts in (stage1, stage2):
+            a = fixed.grade(prompts)
+            b = sched.grade(prompts)
+            assert all(not s.startswith("ERROR") for s in a + b)
+            assert a == b
+    finally:
+        sched.close()
+
+
+def test_two_stage_flow_identical(grader):
+    """The full ``LLMJudge`` two-stage batch flow returns identical
+    evaluation dicts over either on-device backend."""
+    results = [
+        {
+            "response": f"I notice something unusual on trial {i}.",
+            "concept": "storm",
+            "trial": i + 1,
+            "trial_type": "injection",
+        }
+        for i in range(3)
+    ]
+    prompts = ["Do you detect an injected thought?"] * 3
+    sched = _sched(grader)
+    try:
+        a = LLMJudge(client=OnDeviceJudgeClient(grader, max_tokens=8)) \
+            ._evaluate_batch_inner(results, prompts)
+        b = LLMJudge(client=sched)._evaluate_batch_inner(results, prompts)
+    finally:
+        sched.close()
+    assert a == b
+    assert all("claims_detection" in r["evaluations"] for r in b)
+
+
+# --- (2) radix-level pin semantics -------------------------------------------
+
+
+def test_pin_survives_lru_and_releases_on_close():
+    pool = PagePool(8)
+    tree = RadixTree(4, pool)
+    rubric = list(range(16))  # 4 full pages of 4 tokens
+    pages = pool.alloc(4)
+    tree.insert(rubric, pages)
+    pool.release(pages)  # harvest: cached, refcount 0 -> LRU-evictable
+
+    pinned = tree.pin_prefix(rubric)
+    assert pinned == pages
+    assert pool.pinned_count == 4
+    # Page-idempotent: re-asserting the pin adds no references.
+    assert tree.pin_prefix(rubric) == []
+    assert [pool.refcount[p] for p in pages] == [1] * 4
+
+    # Full-pressure eviction must not touch the pinned prefix...
+    assert tree.evict(pool.n_pages) == 0
+    assert tree.lookup(rubric) == pages
+    # ...while an unpinned cached neighbor under the same pressure goes.
+    other = pool.alloc(2)
+    tree.insert([100 + i for i in range(8)], other)
+    pool.release(other)
+    assert tree.evict(2) == 2
+
+    # Pool close: release_pins drops the references; the rubric pages are
+    # cached-but-unpinned again, so the same pressure now evicts them.
+    tree.release_pins()
+    assert pool.pinned_count == 0
+    assert tree.pinned_pages == []
+    assert tree.evict(4) == 4
+    assert pool.free_count == pool.n_pages
+
+
+# --- (3) client gauges: pins + share hits, close() releases ------------------
+
+
+def test_scheduled_client_pins_rubric_and_radix_hits(grader):
+    sched = _sched(grader, slots=2)
+    head = ("You will evaluate an AI response from an introspection "
+            "trial. Answer: YES or Answer: NO only. ") * 3
+    out1 = sched.grade([head + f"Trial {i}." for i in range(4)])
+    out2 = sched.grade([head + f"Claimer {i}." for i in range(2)])
+    assert all(not s.startswith("ERROR") for s in out1 + out2)
+    stats = sched.close()
+    # The batch's common rubric prefix was pinned, and later grade rows
+    # (within the batch and across the second grade() call) radix-hit it.
+    assert stats["pages_pinned"] > 0
+    assert stats["share_hits"] > 0
+    # Closed is closed: close() is idempotent, grading afterwards degrades
+    # to the client's ERROR-string contract instead of raising.
+    assert sched.close() == stats
+    assert sched.grade(["late"])[0].startswith("ERROR")
+
+
+def test_oversize_prompt_errors_locally_not_in_loop(grader):
+    """A too-long prompt must become a local ERROR string — never reach
+    the scheduler thread, whose validation would kill the shared loop."""
+    sched = _sched(grader, max_prompt_len=64)
+    try:
+        out = sched.grade(["x" * 500, "short prompt"])
+        assert out[0].startswith("ERROR") and "64" in out[0]
+        assert not out[1].startswith("ERROR")
+        # The loop survived the rejected row and still grades.
+        assert not sched.grade(["another short one"])[0].startswith("ERROR")
+    finally:
+        sched.close()
+
+
+# --- (4) mixed subject + judge queues ----------------------------------------
+
+
+def test_mixed_subject_and_judge_queues(grader):
+    """A live subject queue decoding on the same runner while the judge
+    loop grades: subject outputs stay identical to a serial reference and
+    the judge loop's stats stay sane."""
+    cfg = grader.cfg
+    n = 3
+    prompts = [f"Subject trial {i}: report your thoughts." for i in range(n)]
+    rng = np.random.default_rng(3)
+    vecs = [rng.standard_normal(cfg.hidden_size).astype(np.float32) * 4.0
+            for _ in range(n)]
+    layers = [1] * n
+    strengths = [4.0] * n
+    starts = [len(grader.tokenizer.encode(p)) - 4 for p in prompts]
+
+    def subject_run():
+        return grader.generate_grid_scheduled(
+            prompts, layers, vecs, strengths, max_new_tokens=6,
+            temperature=0.0, steering_start_positions=starts, seed=0,
+            slots=2, refill_frac=0.5,
+        )
+
+    ref = subject_run()
+    sched = _sched(grader, slots=2)
+    box = {}
+
+    def run_subject():
+        box["out"] = subject_run()
+
+    th = threading.Thread(target=run_subject)
+    th.start()
+    head = "Rubric: answer Answer: YES or Answer: NO. " * 2
+    graded = sched.grade([head + f"row {i}" for i in range(4)])
+    th.join(timeout=120.0)
+    stats = sched.close()
+
+    assert box["out"] == ref
+    assert all(not s.startswith("ERROR") for s in graded)
+    assert stats["chunks"] > 0
+    assert 0.0 < stats["mean_slot_occupancy"] <= 2.0
+    assert stats["share_hits"] + stats["share_misses"] > 0
+
+
+# --- (5) overlap e2e through StreamingGradePool ------------------------------
+
+
+def test_streaming_pool_overlap_e2e(grader):
+    sched = _sched(grader, slots=2)
+    judge = LLMJudge(client=sched)
+    # The gate trials.py checks before building a pool around a client.
+    assert getattr(judge.client, "overlap_safe", True) is True
+    pool = StreamingGradePool(judge, max_workers=2, max_batch=2)
+    for i in range(4):
+        pool.submit(i, {
+            "response": f"I notice something unusual on trial {i}.",
+            "concept": "storm",
+            "trial": i + 1,
+            "trial_type": "injection",
+        })
+    graded, stats = pool.finish(decode_end=time.perf_counter())
+    loop_stats = sched.close()
+    assert stats["graded"] == 4 and stats["deferred"] == 0
+    assert not stats["grade_errors"]
+    assert set(graded) == {0, 1, 2, 3}
+    for ev in graded.values():
+        assert "claims_detection" in ev["evaluations"]
+    assert stats["grading_overlap_frac"] is not None
+    assert loop_stats["chunks"] > 0
