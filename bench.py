@@ -1666,6 +1666,241 @@ def _serving_compare(runner, cfg, tok, slots, max_new, ledger,
     return r
 
 
+def _fleet_compare(runner, cfg, tok, slots, max_new, ledger) -> dict:
+    """Elastic serving fleet: goodput vs replica count, failover identity.
+
+    Boots the full fleet stack in-process — N ServeEngines over the
+    shared runner, each behind a loopback ServeServer, a ServeFleet
+    heartbeating their /healthz leases, and the prefix-aware FleetRouter
+    in front — then measures three legs, run once greedy and once
+    sampled at temperature 0.7 (temperature is engine-global, so each
+    pass boots its own fleets; stream ids stay pinned):
+
+    - reference: sequential on a single replica (the identity oracle);
+    - 1-replica and 2-replica concurrent goodput (the scaling curve; the
+      2-replica figure is the ``fleet_goodput_evals_per_s`` headline
+      perf_gate tracks, aggregated across both passes);
+    - a 2-replica run with ``crash_after_chunks`` armed on replica 0:
+      the router must fail everything over mid-load, client-observed p99
+      TTFT must stay finite through the kill, and every completion —
+      greedy AND sampled — must be byte-identical to the reference.
+    """
+    import http.client as _http
+    import json as _json
+    import threading as _threading
+    import time as _time
+
+    from introspective_awareness_tpu.obs.http import HealthState
+    from introspective_awareness_tpu.obs.registry import MetricsRegistry
+    from introspective_awareness_tpu.runtime.faults import FaultPlan
+    from introspective_awareness_tpu.serve.engine import ServeEngine
+    from introspective_awareness_tpu.serve.fleet import (
+        ReplicaHandle,
+        ServeFleet,
+    )
+    from introspective_awareness_tpu.serve.router import FleetRouter
+    from introspective_awareness_tpu.serve.server import ServeServer
+    from introspective_awareness_tpu.serve.tenants import TenantTable
+
+    n_req = 4
+
+    def make_specs(temp: float) -> list[dict]:
+        return [
+            {
+                "tenant": "chat", "priority": "interactive",
+                "vector": "demo", "layer": max(1, int(cfg.n_layers * 0.6)),
+                "strength": 2.0, "max_new_tokens": max_new,
+                "stream": 7100 + i, "temperature": temp,
+                "prompt": ("fleet bench shared preamble, page-filling "
+                           "text. " * 3 + f"request {i}"),
+            }
+            for i in range(n_req)
+        ]
+
+    def steer(port: int, doc: dict) -> dict:
+        conn = _http.HTTPConnection("127.0.0.1", port, timeout=600)
+        t0 = _time.monotonic()
+        ttft = None
+        try:
+            conn.request("POST", "/v1/steer", _json.dumps(doc).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                body = resp.read(200).decode("utf-8", "replace")
+                return {"error": f"http {resp.status}: {body}"}
+            while True:
+                line = resp.readline()
+                if not line:
+                    return {"error": "stream severed"}
+                if ttft is None:
+                    ttft = _time.monotonic() - t0
+                rec = _json.loads(line)
+                if rec.get("done") or "error" in rec:
+                    rec["_ttft_s"] = ttft
+                    return rec
+        finally:
+            conn.close()
+
+    def drive(port: int, specs: list[dict],
+              rids: list[str]) -> tuple[list[dict], float]:
+        outs: list[dict] = [{} for _ in specs]
+        ths = [
+            _threading.Thread(target=lambda i=i: outs[i].update(
+                steer(port, {**specs[i], "rid": rids[i]})))
+            for i in range(len(specs))
+        ]
+        t0 = _time.monotonic()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=600)
+        return outs, _time.monotonic() - t0
+
+    def boot_fleet(n: int, temp: float, kill_replica=None):
+        reg = MetricsRegistry()
+        engines, servers, handles = [], [], []
+        for k in range(n):
+            # Crash at the FIRST decode chunk: with every request routed
+            # to replica 0 by prefix affinity and only `slots` decoding,
+            # chunk 1 always leaves queued work to fail over.
+            faults = (FaultPlan.from_spec("crash_after_chunks=1")
+                      if kill_replica == k else None)
+            eng = ServeEngine(
+                runner, slots=slots, max_new_tokens=max_new,
+                max_prompt_len=512, temperature=temp, seed=11,
+                preempt_after_s=0.2,
+                tenants=TenantTable(
+                    max_inflight=4 * slots, max_queued=8 * slots,
+                    known_tenants=("chat", "sweep"), registry=reg,
+                ),
+                registry=reg, replica=f"bench-fleet{k}", faults=faults,
+            ).start()
+            # The scheduler-crash probe is what flips /healthz to 503 so
+            # the fleet's lease sweep can declare the replica dead.
+            health = HealthState()
+            health.add_probe(
+                "scheduler",
+                lambda e=eng: (
+                    "crashed" if e._loop_error is not None else None),
+            )
+            srv = ServeServer(eng, port=0, registry=reg,
+                              health=health).start()
+            engines.append(eng)
+            servers.append(srv)
+            handles.append(ReplicaHandle(k, srv.url))
+        fleet = ServeFleet(handles, lease_ttl_s=0.75, heartbeat_s=0.25,
+                           registry=reg)
+        router = FleetRouter(fleet, port=0, registry=reg).start()
+        fleet.start()
+        return reg, engines, servers, fleet, router
+
+    def shutdown(engines, servers, fleet, router) -> int:
+        router.stop()
+        fleet.stop()
+        crashed = 0
+        for eng, srv in zip(engines, servers):
+            srv.stop()
+            try:
+                eng.close()
+            except RuntimeError:
+                crashed += 1
+        return crashed
+
+    def run_pass(temp: float, tag: str) -> dict:
+        specs = make_specs(temp)
+
+        # Leg 0: sequential single-replica reference — the identity
+        # oracle (also warms the decode path so the timed legs measure
+        # steady state). Leg 1: concurrent goodput, same replica.
+        reg, engines, servers, fleet, router = boot_fleet(1, temp)
+        try:
+            ref = [steer(router.port, {**s, "rid": f"{tag}-ref-{i}"})
+                   for i, s in enumerate(specs)]
+            for r in ref:
+                if not r.get("done"):
+                    raise RuntimeError(f"fleet reference leg failed: {r}")
+            outs1, wall1 = drive(router.port, specs,
+                                 [f"{tag}-g1-{i}" for i in range(n_req)])
+        finally:
+            shutdown(engines, servers, fleet, router)
+
+        # Leg 2: clean 2-replica goodput — the perf-gate headline.
+        reg, engines, servers, fleet, router = boot_fleet(2, temp)
+        try:
+            outs2, wall2 = drive(router.port, specs,
+                                 [f"{tag}-g2-{i}" for i in range(n_req)])
+        finally:
+            shutdown(engines, servers, fleet, router)
+
+        # Leg 3: replica 0 crashes mid-load — failover identity.
+        reg, engines, servers, fleet, router = boot_fleet(
+            2, temp, kill_replica=0)
+        try:
+            outsk, wallk = drive(router.port, specs,
+                                 [f"{tag}-fk-{i}" for i in range(n_req)])
+            failovers = reg.value("iat_fleet_failovers_total") or 0
+            reissues = reg.value("iat_router_failover_reissues_total") or 0
+        finally:
+            crashed = shutdown(engines, servers, fleet, router)
+
+        def identical(outs) -> bool:
+            return all(
+                o.get("done") and o.get("text") == ref[i].get("text")
+                for i, o in enumerate(outs)
+            )
+
+        return {
+            "wall1": wall1, "wall2": wall2, "wallk": wallk,
+            "kill_completed": sum(1 for o in outsk if o.get("done")),
+            "kill_ttfts": [o["_ttft_s"] for o in outsk
+                           if o.get("_ttft_s")],
+            "failovers": failovers, "reissues": reissues,
+            "crashed": crashed,
+            "identical": (identical(outs1) and identical(outs2)
+                          and identical(outsk)),
+        }
+
+    greedy = run_pass(0.0, "g")
+    sampled = run_pass(0.7, "s")
+
+    ttfts = sorted(greedy["kill_ttfts"] + sampled["kill_ttfts"])
+    kill_p99 = (round(ttfts[min(len(ttfts) - 1,
+                                int(0.99 * len(ttfts)))], 4)
+                if ttfts else None)
+    total = 2 * n_req
+    r = {
+        "section": "fleet",
+        "requests": total,
+        "slots": slots,
+        "goodput_1rep_evals_per_s": round(
+            total / (greedy["wall1"] + sampled["wall1"]), 4),
+        "fleet_goodput_evals_per_s": round(
+            total / (greedy["wall2"] + sampled["wall2"]), 4),
+        "kill_goodput_evals_per_s": round(
+            (greedy["kill_completed"] + sampled["kill_completed"])
+            / (greedy["wallk"] + sampled["wallk"]), 4),
+        "kill_completed": greedy["kill_completed"]
+        + sampled["kill_completed"],
+        "kill_ttft_p99_s": kill_p99,
+        "kill_failovers": greedy["failovers"] + sampled["failovers"],
+        "kill_reissues": greedy["reissues"] + sampled["reissues"],
+        "kill_crashed_replicas": greedy["crashed"] + sampled["crashed"],
+        "outputs_identical_greedy": greedy["identical"],
+        "outputs_identical_sampled": sampled["identical"],
+    }
+    r["outputs_identical"] = (
+        r["outputs_identical_greedy"] and r["outputs_identical_sampled"])
+    log(
+        f"  [fleet] goodput 1rep {r['goodput_1rep_evals_per_s']} -> 2rep "
+        f"{r['fleet_goodput_evals_per_s']} evals/s; kill legs: "
+        f"{r['kill_completed']}/{total} done through "
+        f"{r['kill_failovers']} failover(s), ttft p99 "
+        f"{r['kill_ttft_p99_s']}s, identical="
+        f"{r['outputs_identical']} (greedy+sampled)"
+    )
+    return r
+
+
 def _coordinator_rpc_bench(n_trials: int = 512, lease_size: int = 8) -> dict:
     """Control-plane microbench: in-process queue vs the RPC coordinator.
 
@@ -2167,6 +2402,14 @@ def main() -> None:
         ledger,
     )
 
+    # ---- elastic serving fleet: router failover + goodput vs replicas ------
+    flt = _gated(
+        "fleet",
+        lambda: _fleet_compare(runner, cfg, tok, batches[0], max_new,
+                               ledger),
+        ledger,
+    )
+
     # ---- multi-host control plane: local vs RPC vs RPC+WAL queue drain -----
     try:
         coord = _coordinator_rpc_bench()
@@ -2480,6 +2723,7 @@ def main() -> None:
         "durability": dur,
         "fabric": fab,
         "serving": srv,
+        "fleet": flt,
         "coordinator_rpc": coord,
         "prefill_memory": pmem,
         "trace": trace_block,

@@ -63,6 +63,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="early-exit drafter depth; default n_layers // 2")
     p.add_argument("--max-wall-s", type=float, default=0.0,
                    help="self-terminate after this many seconds (tests)")
+    p.add_argument("--fleet-replicas", type=int, default=0,
+                   help="N > 0 boots N in-process serve replicas behind "
+                        "the prefix-aware FleetRouter (--port binds the "
+                        "ROUTER; replicas take ephemeral loopback ports)")
+    p.add_argument("--fleet-replica-urls", default=None,
+                   help="comma list of already-running replica base URLs "
+                        "to front with the router instead of booting "
+                        "in-process replicas (real deployments)")
+    p.add_argument("--fleet-lease-ttl-s", type=float, default=3.0,
+                   help="replica heartbeat lease TTL: a silent replica "
+                        "is declared dead (and failed over) within this")
+    p.add_argument("--fleet-heartbeat-s", type=float, default=1.0,
+                   help="fleet /healthz probe + lease renewal period")
+    p.add_argument("--inject-faults", default=None,
+                   help="FaultPlan spec for chaos drills, e.g. "
+                        "'crash_after_chunks=4,kill_serve_replica=1' or "
+                        "'drop_stream_after=3,kill_serve_replica=0'; "
+                        "kill_serve_replica scopes the plan to one "
+                        "replica index (default: all)")
     p.add_argument("--trace", action="store_true",
                    help="attach the chunk flight recorder; serves the "
                         "timeline at GET /trace and writes "
@@ -74,8 +93,31 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _fault_plan(args):
+    """Parse ``--inject-faults`` (or IAT_FAULTS) into a FaultPlan."""
+    from introspective_awareness_tpu.runtime.faults import FaultPlan
+
+    if args.inject_faults:
+        return FaultPlan.from_spec(args.inject_faults)
+    return FaultPlan.from_env()
+
+
+def _scope_faults(plan, replica: int):
+    """Mirror the fabric's ``_faults_for``: a plan carrying
+    ``kill_serve_replica=K`` is inert (None) on every replica but K."""
+    if plan is None:
+        return None
+    if plan.kill_serve_replica is not None and (
+        int(plan.kill_serve_replica) != int(replica)
+    ):
+        return None
+    return plan
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.fleet_replicas > 0 or args.fleet_replica_urls:
+        return _main_fleet(args)
     from introspective_awareness_tpu.cli.sweep import load_subject
     from introspective_awareness_tpu.obs.http import HealthState
     from introspective_awareness_tpu.obs.registry import default_registry
@@ -86,6 +128,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     out_dir = Path(args.output_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     registry = default_registry()
+    faults = _scope_faults(_fault_plan(args), 0)
     runner = load_subject(args.model, args, mesh=None, rules=None)
 
     journal = None
@@ -136,6 +179,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         roofline=meter,
         speculate_k=args.speculate_k,
         draft_layers=args.draft_layers,
+        faults=faults,
     )
     n_recovered = engine.recover()
     engine.start()
@@ -157,6 +201,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         engine, port=args.port, host=args.host,
         registry=registry, health=health,
         profiler=profiler, trace_source=trace,
+        faults=faults,
     ).start()
 
     stop = threading.Event()
@@ -204,6 +249,176 @@ def main(argv: Optional[list[str]] = None) -> int:
     )
     print(f"drained; manifest at {out_dir / 'run_manifest.json'}", flush=True)
     return 1 if crashed else 0
+
+
+def _main_fleet(args) -> int:
+    """Fleet mode: N replicas behind the prefix-aware FleetRouter.
+
+    ``--fleet-replicas N`` boots N in-process engine+server pairs (CI /
+    single-host scale-out: shared params, per-replica journals at
+    ``request_journal.replica<k>.jsonl``); ``--fleet-replica-urls`` fronts
+    replicas already running elsewhere. ``--port`` binds the ROUTER.
+    Every replica decodes from the same seed and folds only the request's
+    stream id into its PRNG, so a failover re-issue is bit-identical at
+    any temperature.
+    """
+    from introspective_awareness_tpu.obs.http import HealthState
+    from introspective_awareness_tpu.obs.registry import default_registry
+    from introspective_awareness_tpu.serve.fleet import (
+        ReplicaHandle,
+        ServeFleet,
+    )
+    from introspective_awareness_tpu.serve.router import FleetRouter
+
+    out_dir = Path(args.output_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    registry = default_registry()
+    plan = _fault_plan(args)
+
+    engines: list = []   # (engine, server, journal) per in-process replica
+    handles: list[ReplicaHandle] = []
+    if args.fleet_replica_urls:
+        urls = [u.strip() for u in str(args.fleet_replica_urls).split(",")
+                if u.strip()]
+        handles = [ReplicaHandle(k, url) for k, url in enumerate(urls)]
+    else:
+        from introspective_awareness_tpu.cli.sweep import load_subject
+        from introspective_awareness_tpu.serve.engine import ServeEngine
+        from introspective_awareness_tpu.serve.server import ServeServer
+        from introspective_awareness_tpu.serve.tenants import TenantTable
+
+        # One set of params, shared read-only by every replica's
+        # scheduler thread — the in-process fleet is a scale-out of
+        # compute, not of weights.
+        runner = load_subject(args.model, args, mesh=None, rules=None)
+        known = [t for t in str(args.tenants).split(",") if t]
+        for k in range(int(args.fleet_replicas)):
+            faults = _scope_faults(plan, k)
+            journal = None
+            jpath = None
+            if args.journal != "off":
+                from introspective_awareness_tpu.runtime.journal import (
+                    TrialJournal,
+                )
+
+                jpath = out_dir / f"request_journal.replica{k}.jsonl"
+                journal = TrialJournal(jpath, {
+                    "kind": "serve",
+                    "model": args.model,
+                    "replica": k,
+                    "seed": int(args.seed),
+                    "temperature": float(args.temperature),
+                    "max_new_tokens": int(args.max_new_tokens),
+                })
+            engine = ServeEngine(
+                runner,
+                slots=args.slots,
+                max_new_tokens=args.max_new_tokens,
+                max_prompt_len=args.max_prompt_len,
+                temperature=args.temperature,
+                seed=args.seed,
+                preempt_after_s=args.preempt_after_s,
+                tenants=TenantTable(
+                    max_inflight=args.quota_inflight,
+                    max_queued=args.quota_queued,
+                    known_tenants=known,
+                    registry=registry,
+                ),
+                journal=journal,
+                registry=registry,
+                replica=f"serve{k}",
+                speculate_k=args.speculate_k,
+                draft_layers=args.draft_layers,
+                faults=faults,
+            )
+            engine.recover()
+            engine.start()
+            health = HealthState()
+            health.add_probe(
+                "scheduler",
+                lambda e=engine: (
+                    "crashed" if e._loop_error is not None else None),
+            )
+            if journal is not None:
+                health.add_probe(
+                    "journal_fsync",
+                    lambda j=journal: (
+                        "fsync failing" if j.fsync_failed else None),
+                )
+            server = ServeServer(
+                engine, port=0, host=args.host,
+                registry=registry, health=health, faults=faults,
+            ).start()
+            engines.append((engine, server, journal))
+            handles.append(ReplicaHandle(
+                k, server.url,
+                journal_path=str(jpath) if jpath is not None else None,
+            ))
+
+    router_health = HealthState()
+    fleet = ServeFleet(
+        handles,
+        lease_ttl_s=args.fleet_lease_ttl_s,
+        heartbeat_s=args.fleet_heartbeat_s,
+        registry=registry,
+        health=router_health,
+    )
+    router = FleetRouter(
+        fleet, port=args.port, host=args.host,
+        registry=registry, health=router_health,
+    ).start()
+    fleet.start()
+
+    stop = threading.Event()
+
+    def _graceful(signum, frame) -> None:
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+
+    print(
+        f"fleet router on {router.url} "
+        f"replicas={','.join(h.url for h in handles)}",
+        flush=True,
+    )
+    t0 = time.monotonic()
+    while not stop.wait(0.25):
+        if args.max_wall_s and time.monotonic() - t0 > args.max_wall_s:
+            break
+
+    # Drain: stop routing first, then walk the replicas down. Replicas
+    # that crashed in a chaos drill surface in the manifest, not the
+    # exit code — a fleet that failed over correctly is a healthy fleet.
+    router.stop()
+    fleet.stop()
+    crashed: list[int] = []
+    for k, (engine, server, journal) in enumerate(engines):
+        server.stop()
+        try:
+            engine.close()
+        except RuntimeError:
+            crashed.append(k)
+        if journal is not None:
+            journal.record_clean_stop()
+            journal.close()
+    manifest = {
+        "kind": "serve_fleet",
+        "model": args.model,
+        "replicas": len(handles),
+        "crashed_replicas": crashed,
+        "fleet": fleet.stats(),
+        "router": router.fleet_doc(),
+        "metrics": registry.snapshot(),
+    }
+    (out_dir / "run_manifest.json").write_text(
+        json.dumps(manifest, indent=2, default=str)
+    )
+    print(
+        f"fleet drained; manifest at {out_dir / 'run_manifest.json'}",
+        flush=True,
+    )
+    return 0
 
 
 if __name__ == "__main__":

@@ -31,6 +31,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from introspective_awareness_tpu.obs.registry import default_registry
+from introspective_awareness_tpu.runtime.retry import (
+    CircuitBreaker,
+    backoff_delay,
+)
 
 
 class RpcFault(Exception):
@@ -195,12 +199,12 @@ class RpcClient:
         self._client_id = client_id or f"c{random.randrange(16**8):08x}"
         self._seq = 0
         self._lock = threading.Lock()
-        # Breaker state: consecutive failed calls; open until cooldown.
-        self._breaker_threshold = int(breaker_threshold)
-        self._breaker_cooldown_s = breaker_cooldown_s
-        self._consecutive_failures = 0
-        self._open_until: Optional[float] = None
-        self._half_open_probe = False
+        # Breaker state machine lives in runtime.retry; this client only
+        # wires the gauge and the CoordinatorUnavailable surface.
+        self._breaker = CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s,
+        )
         reg = registry if registry is not None else default_registry()
         self._g_breaker = reg.gauge(
             "iat_coordinator_breaker_state",
@@ -223,45 +227,36 @@ class RpcClient:
             return json.loads(resp.read().decode("utf-8"))
 
     def _backoff(self, attempt: int) -> float:
-        delay = min(
-            self.backoff_base_s * (2 ** attempt), self.backoff_ceiling_s
+        return backoff_delay(
+            attempt, base_s=self.backoff_base_s,
+            ceiling_s=self.backoff_ceiling_s,
         )
-        return delay + random.uniform(0, 0.25 * delay)
 
     def _breaker_admit(self) -> None:
-        with self._lock:
-            if self._open_until is None:
-                return
-            now = time.monotonic()
-            if now < self._open_until:
-                self._g_breaker.set(1)
-                raise CoordinatorUnavailable(
-                    f"coordinator {self.base_url} unreachable "
-                    f"(circuit open after {self._consecutive_failures} "
-                    f"consecutive failed calls)"
-                )
-            if self._half_open_probe:
-                raise CoordinatorUnavailable(
-                    f"coordinator {self.base_url} unreachable "
-                    "(half-open probe already in flight)"
-                )
-            self._half_open_probe = True
+        if self._breaker.state == "closed":
+            return
+        if self._breaker.allow():  # acquired the single half-open probe
             self._g_breaker.set(2)
+            return
+        if self._breaker.state == "open":
+            self._g_breaker.set(1)
+            raise CoordinatorUnavailable(
+                f"coordinator {self.base_url} unreachable "
+                f"(circuit open after "
+                f"{self._breaker.consecutive_failures} "
+                f"consecutive failed calls)"
+            )
+        raise CoordinatorUnavailable(
+            f"coordinator {self.base_url} unreachable "
+            "(half-open probe already in flight)"
+        )
 
     def _breaker_record(self, ok: bool) -> None:
-        with self._lock:
-            self._half_open_probe = False
-            if ok:
-                self._consecutive_failures = 0
-                self._open_until = None
-                self._g_breaker.set(0)
-            else:
-                self._consecutive_failures += 1
-                if self._consecutive_failures >= self._breaker_threshold:
-                    self._open_until = (
-                        time.monotonic() + self._breaker_cooldown_s
-                    )
-                    self._g_breaker.set(1)
+        self._breaker.record(ok)
+        if ok:
+            self._g_breaker.set(0)
+        elif self._breaker.tripped:
+            self._g_breaker.set(1)
 
     def call(self, method: str, params: Optional[dict] = None) -> dict:
         """POST one logical operation; retry transient failures with the

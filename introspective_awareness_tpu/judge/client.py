@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import asyncio
 import os
-import random
 import threading
 from collections import deque
 from typing import Optional, Protocol, Sequence
@@ -37,6 +36,10 @@ from introspective_awareness_tpu.runtime.scheduler import (
     SchedulerFeed,
     run_scheduled_paged,
 )
+from introspective_awareness_tpu.runtime.retry import (
+    backoff_delay,
+    retry_after_seconds,
+)
 from introspective_awareness_tpu.runtime.spec_control import (
     AUTO_K_MAX,
     SpecController,
@@ -46,29 +49,9 @@ from introspective_awareness_tpu.runtime.spec_control import (
 )
 
 
-def _retry_after_seconds(exc: Exception) -> Optional[float]:
-    """Extract a usable ``Retry-After`` value from an API error, if any.
-
-    OpenAI-compatible servers attach the header to 429/503 responses;
-    honoring it beats guessing with exponential backoff. Returns seconds
-    (clamped to [0, 120]) or ``None`` when absent/unparseable. Only the
-    delta-seconds form is handled — HTTP-date values are rare on these
-    APIs and a wrong parse would oversleep.
-    """
-    response = getattr(exc, "response", None)
-    headers = getattr(response, "headers", None)
-    if headers is None:
-        return None
-    try:
-        raw = headers.get("retry-after") or headers.get("Retry-After")
-    except Exception:  # noqa: BLE001 - exotic mapping types
-        return None
-    if raw is None:
-        return None
-    try:
-        return min(max(float(raw), 0.0), 120.0)
-    except (TypeError, ValueError):
-        return None
+# The Retry-After clamp lives in runtime.retry now; this alias keeps the
+# judge-module import path (and its [0, 120] clamp default) stable.
+_retry_after_seconds = retry_after_seconds
 
 
 class JudgeClient(Protocol):
@@ -184,11 +167,10 @@ class OpenAIJudgeClient:
                 # when it sends one (rate limits), plus jitter so the
                 # max_concurrent in-flight requests that got 429'd together
                 # don't retry in lockstep and trip the limiter again.
-                delay: float = 2**attempt
-                retry_after = _retry_after_seconds(last_error)
-                if retry_after is not None:
-                    delay = max(delay, retry_after)
-                await asyncio.sleep(delay + random.uniform(0, 0.25 * delay))
+                await asyncio.sleep(backoff_delay(
+                    attempt, base_s=1.0,
+                    retry_after=retry_after_seconds(last_error),
+                ))
         raise last_error  # type: ignore[misc]
 
     def grade(self, prompts: Sequence[str]) -> list[str]:

@@ -56,6 +56,9 @@ from introspective_awareness_tpu.judge.judge import (
     reconstruct_trial_prompts,
 )
 from introspective_awareness_tpu.obs.registry import default_registry
+from introspective_awareness_tpu.runtime.retry import (
+    CircuitBreaker as _SharedBreaker,
+)
 
 _STOP = object()
 
@@ -63,24 +66,19 @@ _STOP = object()
 BREAKER_STATE_NUM = {"closed": 0, "half-open": 1, "open": 2}
 
 
-class CircuitBreaker:
+class CircuitBreaker(_SharedBreaker):
     """Consecutive-failure circuit breaker shared across grade pools.
 
-    States: *closed* (calls flow), *open* (calls rejected until
-    ``cooldown_s`` since the trip), *half-open* (one probe allowed; its
-    outcome closes or re-opens the circuit). ``allow()`` is asked before
-    every judge call; callers that get ``False`` defer instead of calling.
-    Thread-safe — one instance is shared by every pool and the post-hoc
-    grading path of a sweep, so a dead judge trips it once, sweep-wide.
+    The state machine lives in :class:`runtime.retry.CircuitBreaker`;
+    this subclass only wires the judge live-metrics gauge. One instance
+    is shared by every pool and the post-hoc grading path of a sweep, so
+    a dead judge trips it once, sweep-wide. The clock is late-bound
+    through this module's ``time`` so tests can monkeypatch it.
     """
 
     def __init__(self, failure_threshold: int = 3, cooldown_s: float = 30.0):
-        self.failure_threshold = max(1, int(failure_threshold))
-        self.cooldown_s = float(cooldown_s)
-        self._lock = threading.Lock()
-        self._failures = 0
-        self._opened_at: Optional[float] = None
-        self._probing = False
+        super().__init__(failure_threshold, cooldown_s,
+                         clock=lambda: time.monotonic())
         self._gauge = default_registry().gauge(
             "iat_judge_breaker_state",
             "judge circuit state at last transition "
@@ -88,42 +86,15 @@ class CircuitBreaker:
         )
         self._gauge.set(0)
 
-    @property
-    def state(self) -> str:
-        with self._lock:
-            if self._opened_at is None:
-                return "closed"
-            if time.monotonic() - self._opened_at >= self.cooldown_s:
-                return "half-open"
-            return "open"
-
-    def allow(self) -> bool:
-        with self._lock:
-            if self._opened_at is None:
-                return True
-            if time.monotonic() - self._opened_at < self.cooldown_s:
-                return False
-            # Half-open: exactly one in-flight probe at a time.
-            if self._probing:
-                return False
-            self._probing = True
-            return True
-
     def record_success(self) -> None:
-        with self._lock:
-            self._failures = 0
-            self._opened_at = None
-            self._probing = False
+        super().record_success()
         self._gauge.set(0)
 
     def record_failure(self) -> None:
-        with self._lock:
-            self._probing = False
-            self._failures += 1
-            if self._failures >= self.failure_threshold:
-                self._opened_at = time.monotonic()
-            opened = self._opened_at is not None
-        self._gauge.set(BREAKER_STATE_NUM["open" if opened else "closed"])
+        super().record_failure()
+        self._gauge.set(
+            BREAKER_STATE_NUM["open" if self.tripped else "closed"]
+        )
 
 
 class StreamingGradePool:

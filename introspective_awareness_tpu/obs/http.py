@@ -274,6 +274,7 @@ def handle_observability_get(
     profiler: Optional[Any] = None,
     trace_source: Optional[Any] = None,
     query: str = "",
+    extra_routes: Optional[dict] = None,
 ) -> bool:
     """Serve the shared observability GET routes (``/metrics``,
     ``/progress``, ``/registry``, ``/healthz``, plus ``/profile`` when a
@@ -282,7 +283,15 @@ def handle_observability_get(
     returning a Perfetto doc — is) on any stdlib handler. Returns False
     when ``path`` is not an observability route, so callers (e.g. the
     serving front-end, which multiplexes these onto its request port)
-    can fall through to their own routing."""
+    can fall through to their own routing.
+
+    ``extra_routes`` maps additional paths to zero-arg callables
+    returning ``(status, content_type, body_bytes)`` — the fleet router
+    mounts its ``/fleet`` topology doc on the shared plane this way."""
+    if extra_routes and path in extra_routes:
+        status, ctype, body = extra_routes[path]()
+        send_http(handler, status, ctype, body)
+        return True
     if path == "/profile" and profiler is not None:
         from urllib.parse import parse_qs
 

@@ -97,6 +97,13 @@ def _serving_goodput(doc: dict) -> Optional[float]:
     return srv.get("serving_goodput_evals_per_s")
 
 
+def _fleet_goodput(doc: dict) -> Optional[float]:
+    sec = doc.get("fleet") or {}
+    if sec.get("skipped"):
+        return None
+    return sec.get("fleet_goodput_evals_per_s")
+
+
 def _ondevice_grading(doc: dict) -> Optional[float]:
     sec = doc.get("ondevice_grading") or {}
     if sec.get("skipped"):
@@ -144,6 +151,11 @@ HEADLINES: tuple = (
     # throughput metrics above don't: wide relative tolerance. Rounds
     # predating the section skip, never fail.
     ("serving_goodput_evals_per_s", _serving_goodput, True, 0.25, 0.0),
+    # Aggregate 2-replica goodput through the fleet router (bench "fleet"
+    # section, clean leg). Same wall-clock/loopback jitter profile as the
+    # serving headline, so the same wide tolerance. History-tolerant:
+    # rounds predating the section skip, never fail.
+    ("fleet_goodput_evals_per_s", _fleet_goodput, True, 0.25, 0.0),
     # Co-scheduled on-device grading throughput (ScheduledJudgeClient leg
     # of the bench's "ondevice_grading" A/B, graded under live subject
     # load). The concurrent subject queue makes this a wall-clock measure
@@ -327,6 +339,9 @@ def inject_regression(history: list[tuple[Optional[dict], Any]],
     if isinstance(cur.get("serving"), dict) and \
             cur["serving"].get("serving_goodput_evals_per_s"):
         cur["serving"]["serving_goodput_evals_per_s"] *= factor
+    if isinstance(cur.get("fleet"), dict) and \
+            cur["fleet"].get("fleet_goodput_evals_per_s"):
+        cur["fleet"]["fleet_goodput_evals_per_s"] *= factor
     if isinstance(cur.get("paged_attn_kernel"), dict) and \
             cur["paged_attn_kernel"].get(
                 "paged_attn_kernel_decode_steps_per_s"):

@@ -30,6 +30,14 @@ strike —
   (``os._exit``) while handling its n-th request — the
   coordinator-crash drill; worker hosts ride the outage on client
   retries and a restarted coordinator resumes from its WAL.
+- ``kill_serve_replica=k``: scope the whole plan to serving-fleet
+  replica k (the fleet arms the plan on that replica only), so
+  ``crash_after_chunks=4,kill_serve_replica=1`` crashes replica 1's
+  scheduler mid-load and the router's failover drill takes over.
+- ``drop_stream_after=n``: one-shot — sever the serve HTTP response
+  stream (close without the terminal line) right after the n-th
+  streamed ndjson line process-wide; the replica stays alive, forcing
+  the router's same-rid re-issue / result-fetch path.
 
 Plans parse from a spec string (``--inject-faults`` /  the ``IAT_FAULTS``
 env var): comma-separated ``key=value`` pairs, bare keys meaning 1 —
@@ -92,6 +100,18 @@ class FaultPlan:
     # Coordinator targeting: hard-exit while handling the n-th RPC/HTTP
     # request (only the coordinator process ticks the "rpc" point).
     kill_coordinator_after: int = 0
+    # Serving-fleet targeting: None = every serve replica; an int scopes
+    # the plan to that serve replica id (the fleet hands other replicas
+    # faults=None), so e.g. ``crash_after_chunks=4,kill_serve_replica=1``
+    # crashes exactly replica 1's scheduler loop mid-load — heartbeats
+    # stop, its lease expires, and the router fails over.
+    kill_serve_replica: Optional[int] = None
+    # Stream severing: one-shot — the serve HTTP layer drops the client
+    # connection (no terminal line, no chunked trailer) right after
+    # writing the n-th streamed ndjson line process-wide. The replica
+    # itself stays alive, exercising the router's re-issue-with-same-rid
+    # path (duplicate admission must be refused, result fetched instead).
+    drop_stream_after: int = 0
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
     )
@@ -99,11 +119,14 @@ class FaultPlan:
     _admissions: int = field(default=0, repr=False, compare=False)
     _rpcs: int = field(default=0, repr=False, compare=False)
     _judge_fails: int = field(default=0, repr=False, compare=False)
+    _stream_lines: int = field(default=0, repr=False, compare=False)
+    _stream_dropped: bool = field(default=False, repr=False, compare=False)
 
     _KEYS = (
         "crash_after_chunks", "crash_on_admission",
         "judge_timeout", "judge_rate_limit", "judge_5xx", "torn_tail",
         "kill_replica", "kill_host", "kill_coordinator_after",
+        "kill_serve_replica", "drop_stream_after",
     )
 
     @classmethod
@@ -171,6 +194,25 @@ class FaultPlan:
                     )
             else:
                 raise ValueError(f"unknown fault point {point!r}")
+
+    # -- serving stream injection point -------------------------------------
+
+    def stream_line(self) -> bool:
+        """Tick one streamed ndjson line written to a serve client; return
+        ``True`` exactly once — on the ``drop_stream_after``-th line —
+        meaning the HTTP layer must sever the connection NOW (close the
+        socket without the terminal line or chunked trailer, the way a
+        routed connection dies under a mid-stream network fault)."""
+        if not self.drop_stream_after:
+            return False
+        with self._lock:
+            if self._stream_dropped:
+                return False
+            self._stream_lines += 1
+            if self._stream_lines == self.drop_stream_after:
+                self._stream_dropped = True
+                return True
+        return False
 
     # -- judge injection points ---------------------------------------------
 

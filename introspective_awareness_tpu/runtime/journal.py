@@ -381,6 +381,13 @@ class TrialJournal:
                 if rid not in self._request_done
             }
 
+    def request_result(self, rid: str) -> Optional[dict]:
+        """Terminal result for a request, or None while it is still open —
+        the serve ``/v1/result`` lookup for requests that finished in an
+        earlier process life (recovered orphans, failover re-issues)."""
+        with self._lock:
+            return self._request_done.get(str(rid))
+
     def record_clean_stop(self) -> None:
         """Graceful-shutdown marker: in-flight chunks drained, journal
         flushed — resume can trust there was no torn write."""
@@ -492,3 +499,32 @@ class TrialJournal:
             self.path.unlink()
         except FileNotFoundError:
             pass
+
+
+def scan_request_records(path: Path | str) -> tuple[dict, dict]:
+    """Read-only scan of another process's serve journal for its request
+    records: ``(pending, done)`` — rid→spec for accepted requests with no
+    terminal record (acceptance order) and rid→result for terminal ones.
+
+    This is the fleet router's failover work list: the victim replica may
+    have died mid-append, so every line that fails CRC framing is skipped
+    (torn-tail tolerance), and the file is never opened for writing — a
+    replica that turns out to be alive keeps appending undisturbed.
+    """
+    specs: dict[str, dict] = {}
+    done: dict[str, dict] = {}
+    try:
+        raw_lines = Path(path).read_bytes().splitlines(keepends=True)
+    except OSError:
+        return {}, {}
+    for raw in raw_lines:
+        rec = _parse_line(raw)
+        if rec is None:
+            continue
+        ev = rec.get("ev")
+        if ev == "request" and "rid" in rec:
+            specs[str(rec["rid"])] = rec.get("spec") or {}
+        elif ev == "request_done" and "rid" in rec:
+            done[str(rec["rid"])] = rec.get("result") or {}
+    pending = {rid: s for rid, s in specs.items() if rid not in done}
+    return pending, done

@@ -263,4 +263,71 @@ class RadixTree:
         return self._n_nodes
 
 
-__all__ = ["PagePool", "RadixTree"]
+class HostPageTrie:
+    """Page-granular trie over arbitrary sliceable sequences — the
+    shared-prefix mass ESTIMATOR (no pool pages, no device state).
+
+    Two consumers share it: the runner's ``_paged_route`` cost model
+    walks token rows through it to predict what the real
+    :class:`RadixTree` would dedup, and the serving fleet's router scores
+    replicas by the page mass a prompt shares with what each replica has
+    already been routed (character pages there — the router has no
+    tokenizer). Matching follows the scheduler tree's exact-prefix rule:
+    a page counts only while every page before it matched too.
+
+    ``max_pages`` bounds memory for long-lived consumers (the router):
+    once the trie holds that many nodes, new pages stop being inserted —
+    routing quality degrades gracefully instead of the trie growing with
+    total traffic. 0 means unbounded (the cost model's per-call tries).
+    """
+
+    def __init__(self, page_size: int, max_pages: int = 0) -> None:
+        self.page = int(page_size)
+        self.max_pages = int(max_pages)
+        self.root: dict = {}
+        self.n_pages = 0
+
+    def walk(
+        self,
+        seq,
+        insert_pages: Optional[int] = None,
+        lookup_pages: Optional[int] = None,
+    ) -> int:
+        """Walk ``seq`` page-by-page: count contiguous-from-the-start
+        full pages already present (up to ``lookup_pages``), inserting
+        missing nodes along the way (up to ``insert_pages``). Returns the
+        matched page count. Defaults walk every full page of ``seq``."""
+        pg = self.page
+        if insert_pages is None:
+            insert_pages = len(seq) // pg
+        if lookup_pages is None:
+            lookup_pages = insert_pages
+        node, matched = self.root, 0
+        for p in range(insert_pages):
+            key = tuple(seq[p * pg:(p + 1) * pg])
+            nxt = node.get(key)
+            if nxt is None:
+                if self.max_pages and self.n_pages >= self.max_pages:
+                    break
+                nxt = node[key] = {}
+                self.n_pages += 1
+            elif p < lookup_pages and matched == p:
+                matched += 1
+            node = nxt
+        return matched
+
+    def match_pages(self, seq) -> int:
+        """Pure lookup: contiguous full pages of ``seq`` already present,
+        inserting nothing — the router's scoring probe."""
+        pg = self.page
+        node, matched = self.root, 0
+        for p in range(len(seq) // pg):
+            nxt = node.get(tuple(seq[p * pg:(p + 1) * pg]))
+            if nxt is None:
+                break
+            matched += 1
+            node = nxt
+        return matched
+
+
+__all__ = ["HostPageTrie", "PagePool", "RadixTree"]

@@ -1,0 +1,148 @@
+"""Shared retry discipline: jittered backoff, Retry-After, circuit breaker.
+
+Every HTTP-ish client in the tree retries the same way — exponential
+delay ``base * 2**attempt``, optionally clamped to a ceiling, lifted to
+the server's ``Retry-After`` when one arrives, plus 0–25% jitter so a
+burst of callers that failed together doesn't retry in lockstep — and
+fronts the retries with a consecutive-failure circuit breaker
+(open until cooldown, then a single half-open probe). This module is
+the one implementation; ``fabric/transport.py`` (coordinator RPC),
+``judge/client.py`` (OpenAI-compatible judge), ``judge/streaming.py``
+(grade pools), and ``serve/router.py`` (fleet replica calls) all build
+on it.
+
+Host-side stdlib only — no jax, safe to import anywhere.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from threading import Lock
+from typing import Callable, Optional
+
+
+def retry_after_seconds(
+    exc: Exception, clamp_s: float = 120.0
+) -> Optional[float]:
+    """Extract a usable ``Retry-After`` value from an API error, if any.
+
+    OpenAI-compatible servers attach the header to 429/503 responses;
+    honoring it beats guessing with exponential backoff. Returns seconds
+    (clamped to ``[0, clamp_s]``) or ``None`` when absent/unparseable.
+    Only the delta-seconds form is handled — HTTP-date values are rare
+    on these APIs and a wrong parse would oversleep.
+    """
+    response = getattr(exc, "response", None)
+    headers = getattr(response, "headers", None)
+    if headers is None:
+        return None
+    try:
+        raw = headers.get("retry-after") or headers.get("Retry-After")
+    except Exception:  # noqa: BLE001 - exotic mapping types
+        return None
+    if raw is None:
+        return None
+    try:
+        return min(max(float(raw), 0.0), clamp_s)
+    except (TypeError, ValueError):
+        return None
+
+
+def backoff_delay(
+    attempt: int,
+    base_s: float = 1.0,
+    ceiling_s: Optional[float] = None,
+    retry_after: Optional[float] = None,
+    jitter_frac: float = 0.25,
+    rng: Callable[[float, float], float] = random.uniform,
+) -> float:
+    """Delay before retry number ``attempt`` (0-based).
+
+    ``base_s * 2**attempt``, clamped to ``ceiling_s`` when given, lifted
+    to ``retry_after`` when the server sent one (the lift wins over the
+    ceiling — the server knows), plus ``uniform(0, jitter_frac*delay)``.
+    """
+    delay = base_s * (2 ** attempt)
+    if ceiling_s is not None:
+        delay = min(delay, ceiling_s)
+    if retry_after is not None:
+        delay = max(delay, retry_after)
+    return delay + rng(0.0, jitter_frac * delay)
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker.
+
+    States: *closed* (calls flow), *open* (calls rejected until
+    ``cooldown_s`` since the trip), *half-open* (one probe allowed; its
+    outcome closes or re-opens the circuit). ``allow()`` is asked before
+    every call; callers that get ``False`` defer instead of calling.
+    Thread-safe. ``clock`` is injectable for tests (and late-bound, so
+    monkeypatching a caller module's ``time.monotonic`` still works when
+    the caller passes ``lambda: time.monotonic()``).
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        cooldown_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = Lock()
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_at is None:
+                return "closed"
+            if self._clock() - self._opened_at >= self.cooldown_s:
+                return "half-open"
+            return "open"
+
+    @property
+    def tripped(self) -> bool:
+        with self._lock:
+            return self._opened_at is not None
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._failures
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if self._clock() - self._opened_at < self.cooldown_s:
+                return False
+            # Half-open: exactly one in-flight probe at a time.
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probing = False
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._opened_at = self._clock()
+
+    def record(self, ok: bool) -> None:
+        if ok:
+            self.record_success()
+        else:
+            self.record_failure()

@@ -46,6 +46,7 @@ from introspective_awareness_tpu.runtime.generate import (
     generate_tokens_prefix,
 )
 from introspective_awareness_tpu.runtime.journal import SweepInterrupted
+from introspective_awareness_tpu.runtime.radix import HostPageTrie
 from introspective_awareness_tpu.runtime.scheduler import (
     PagedTrial,
     TrialRequest,
@@ -290,7 +291,7 @@ class ModelRunner:
         pg = int(self.kv_page_size)
         s = np.asarray(strength_arr, np.float32)
         total = sum(len(r) for r in rows)
-        trie: dict = {}
+        trie = HostPageTrie(pg)
         shared_tokens = 0
         for i, r in enumerate(rows):
             plen = len(r)
@@ -300,17 +301,11 @@ class ModelRunner:
             else:
                 start = None if starts is None else starts[i]
                 cap = 0 if start is None else min(plen, max(0, int(start)))
-            lookup_pages = min(cap, plen - 1) // pg
-            insert_pages = cap // pg
-            node, matched = trie, 0
-            for p in range(insert_pages):
-                key = tuple(r[p * pg:(p + 1) * pg])
-                nxt = node.get(key)
-                if nxt is None:
-                    nxt = node[key] = {}
-                elif p < lookup_pages and matched == p:
-                    matched += 1
-                node = nxt
+            matched = trie.walk(
+                r,
+                insert_pages=cap // pg,
+                lookup_pages=min(cap, plen - 1) // pg,
+            )
             shared_tokens += matched * pg
         classic_cost = L0 + (total - L0 * len(rows))
         paged_cost = total - shared_tokens

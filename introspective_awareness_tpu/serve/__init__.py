@@ -17,22 +17,33 @@ tenant path.
   the shared observability routes)
 - :mod:`.loadgen` — closed-loop + open-arrival load generator used by
   bench's ``serving`` section and the CI smoke lane
+- :mod:`.fleet` — :class:`~.fleet.ServeFleet`: heartbeat-lease liveness
+  over N replicas, reusing the fabric's lease-TTL machinery
+- :mod:`.router` — :class:`~.router.FleetRouter`: prefix-aware routing
+  with bit-identical drain/kill failover and exactly-once re-issue
 """
 
 from introspective_awareness_tpu.serve.engine import ServeEngine
+from introspective_awareness_tpu.serve.fleet import ReplicaHandle, ServeFleet
 from introspective_awareness_tpu.serve.request import (
+    DuplicateRequest,
     QuotaError,
     RequestError,
     SteerRequest,
     VectorStore,
 )
+from introspective_awareness_tpu.serve.router import FleetRouter
 from introspective_awareness_tpu.serve.server import ServeServer
 from introspective_awareness_tpu.serve.tenants import TenantTable
 
 __all__ = [
+    "DuplicateRequest",
+    "FleetRouter",
     "QuotaError",
+    "ReplicaHandle",
     "RequestError",
     "ServeEngine",
+    "ServeFleet",
     "ServeServer",
     "SteerRequest",
     "TenantTable",

@@ -33,7 +33,7 @@ import queue
 import threading
 import time
 import zlib
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Optional
 
 import numpy as np
@@ -55,6 +55,7 @@ from introspective_awareness_tpu.runtime.spec_control import (
     spec_cell_key,
 )
 from introspective_awareness_tpu.serve.request import (
+    DuplicateRequest,
     QuotaError,
     RequestError,
     SteerRequest,
@@ -114,6 +115,7 @@ class ServeEngine(SchedulerFeed):
         roofline=None,
         speculate_k=0,
         draft_layers: Optional[int] = None,
+        faults=None,
     ) -> None:
         self.runner = runner
         self.slots = int(slots)
@@ -132,6 +134,11 @@ class ServeEngine(SchedulerFeed):
                 AUTO_K_MAX, max(1, self.max_new_tokens - 1))
         self.draft_layers = draft_layers
         self._spec_priority: dict[int, str] = {}
+        # Optional FaultPlan: chunk-count crash injection rides the same
+        # scheduler hook the sweep loop uses; the fleet's chaos lane kills
+        # one replica's engine this way (kill_serve_replica scoping is the
+        # caller's job — a scoped-out replica passes faults=None).
+        self.faults = faults
         self.journal = journal
         self.replica = str(replica)
         # Optional flight recorder + roofline meter for the serving loop:
@@ -147,6 +154,14 @@ class ServeEngine(SchedulerFeed):
 
         self._lock = threading.Lock()
         self._streams: dict[int, ResponseStream] = {}
+        # Idempotency plane: rids admitted and still in flight, plus a
+        # bounded cache of terminal docs so a router that lost the HTTP
+        # response (or deliberately got a stream severed under it) can
+        # retry the submit, receive DuplicateRequest (409), and fetch the
+        # result via ``result_for`` — never double-admitting the decode.
+        self._live_rids: dict[str, int] = {}
+        self._done_cache: "OrderedDict[str, dict]" = OrderedDict()
+        self._done_cache_cap = 1024
         self._q_inter: deque[int] = deque()
         self._q_bulk: deque[int] = deque()
         self._running: set[int] = set()
@@ -202,6 +217,10 @@ class ServeEngine(SchedulerFeed):
                 f"prompt is {plen} tokens; server accepts 1..."
                 f"{self.max_prompt_len}"
             )
+        # Idempotency pre-check before quota, so a retried submit never
+        # burns tenant budget (re-checked under the admission lock below
+        # against concurrent retries of the same rid).
+        self._check_duplicate(req.rid)
         trial = PagedTrial(
             prompt_ids=prompt_ids,
             steer_layer=int(req.layer),
@@ -220,6 +239,9 @@ class ServeEngine(SchedulerFeed):
             if not self._accepting:
                 self.tenants.on_finish(req.tenant, was_running=False)
                 raise RequestError("server is draining; resubmit elsewhere")
+            if req.rid in self._live_rids or req.rid in self._done_cache:
+                self.tenants.on_finish(req.tenant, was_running=False)
+                raise DuplicateRequest(req.rid)
             if req.stream is not None:
                 sid = int(req.stream)
                 if sid in self._streams:
@@ -230,6 +252,7 @@ class ServeEngine(SchedulerFeed):
             self._next_stream = max(self._next_stream, sid + 1)
             st = ResponseStream(req, trial, sid)
             self._streams[sid] = st
+            self._live_rids[req.rid] = sid
             # id(trial) is stable for the stream's lifetime (the trial
             # object rides the scheduler queue, including preemption
             # requeues) — the spec controller's cell key folds the
@@ -243,6 +266,44 @@ class ServeEngine(SchedulerFeed):
              else self._q_bulk).append(sid)
         self._c_accepted.inc(priority=req.priority)
         return st
+
+    def _check_duplicate(self, rid: str) -> None:
+        with self._lock:
+            if rid in self._live_rids or rid in self._done_cache:
+                raise DuplicateRequest(rid)
+        # A rid that reached its terminal record in an EARLIER process
+        # life (recovered orphan, pre-restart completion) is just as
+        # admitted: the journal is the durable half of the dedup set.
+        if self.journal is not None and (
+            self.journal.request_result(rid) is not None
+        ):
+            raise DuplicateRequest(rid)
+
+    def result_for(self, rid: str) -> tuple[str, Optional[dict]]:
+        """Idempotent result lookup for ``GET /v1/result?rid=``.
+
+        Returns ``("done", doc)`` once the request has a terminal
+        document (memory cache first, then the journal's durable record —
+        which survives process restarts), ``("live", None)`` while it is
+        admitted and still decoding, ``("unknown", None)`` otherwise.
+        """
+        rid = str(rid)
+        with self._lock:
+            doc = self._done_cache.get(rid)
+            live = rid in self._live_rids
+        if doc is not None:
+            return "done", dict(doc)
+        if live:
+            return "live", None
+        if self.journal is not None:
+            res = self.journal.request_result(rid)
+            if res is not None:
+                return "done", {**res, "done": True, "rid": rid}
+            if rid in self.journal.pending_requests():
+                # Journaled as accepted but not yet re-enqueued (the boot
+                # gap before recover()) — in flight from the caller's view.
+                return "live", None
+        return "unknown", None
 
     def grade_texts(
         self,
@@ -423,18 +484,28 @@ class ServeEngine(SchedulerFeed):
         self.tenants.on_finish(st.req.tenant)
         self._c_completed.inc(priority=st.req.priority)
         if self.journal is not None:
+            # ``text`` rides the terminal record so a result that outlives
+            # its client (recovered orphan, failover re-issue) is still
+            # deliverable — /v1/result reads it back across restarts.
             self.journal.record_request_done(st.req.rid, {
+                "text": text,
                 "n_tokens": int(np.asarray(toks).shape[0]),
                 "preemptions": int(st.preemptions),
                 "trace_id": st.trace_id,
             })
-        st.q.put({
+        doc = {
             "done": True, "rid": st.req.rid, "text": text,
             "n_tokens": int(np.asarray(toks).shape[0]),
             "preemptions": int(st.preemptions),
             "stream": st.stream_id,
             "trace_id": st.trace_id,
-        })
+        }
+        with self._lock:
+            self._live_rids.pop(st.req.rid, None)
+            self._done_cache[st.req.rid] = doc
+            while len(self._done_cache) > self._done_cache_cap:
+                self._done_cache.popitem(last=False)
+        st.q.put(doc)
 
     # -- speculation policy (scheduler thread) ------------------------------
 
@@ -498,6 +569,7 @@ class ServeEngine(SchedulerFeed):
                     token_cb=self._on_tokens,
                     max_prompt_len=self.max_prompt_len,
                     replica=self.replica,
+                    faults=self.faults,
                     trace=self.trace,
                     roofline=self.roofline,
                     decode_kernel=getattr(r, "decode_kernel", "xla"),
@@ -528,6 +600,8 @@ class ServeEngine(SchedulerFeed):
         for sid in orphans:
             st = self._streams.pop(sid, None)
             if st is not None:
+                with self._lock:
+                    self._live_rids.pop(st.req.rid, None)
                 st.q.put({"error": "server draining; request journaled "
                                    "for recovery", "rid": st.req.rid})
         if self._thread is not None:

@@ -1,0 +1,245 @@
+"""Serving-fleet membership: heartbeat leases, liveness, failover hooks.
+
+:class:`ServeFleet` tracks N serve replicas the way the sweep fabric
+tracks worker hosts — by reusing the SAME lease-TTL machinery
+(:class:`~introspective_awareness_tpu.fabric.queue.PartitionedTrialQueue`):
+the fleet builds an N-item queue partitioned one index per replica, and
+each registered replica holds the lease on its own index. The heartbeat
+thread probes every replica's ``/healthz`` each ``heartbeat_s``; a 200
+renews the lease (``touch``), anything else lets it age. A replica that
+goes silent therefore EXPIRES out of ``outstanding_ids()`` within one
+``lease_ttl_s`` — the exact wedged-holder semantics the fabric already
+proves — at which point the fleet counts a failover, flips the
+``iat_fleet_replicas_live`` gauge, and fires the registered death
+callbacks (the router replays the victim's journal from one of these).
+A replica whose probe recovers re-acquires its own partition's index and
+rejoins the live set.
+
+Host-side stdlib only — no jax. Replicas are addressed by URL, so the
+same fleet object fronts in-process loopback servers (CI) and real
+remote deployments (``--fleet-replica-urls``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from introspective_awareness_tpu.fabric.queue import PartitionedTrialQueue
+from introspective_awareness_tpu.obs.http import HealthState
+from introspective_awareness_tpu.obs.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+
+
+@dataclass
+class ReplicaHandle:
+    """One registered serve replica."""
+
+    index: int
+    url: str
+    # The replica's request journal, when the router can reach it (same
+    # filesystem: in-process fleets, shared-fs deployments). None means
+    # death still fails over live relays, but orphaned accepted requests
+    # cannot be replayed from here.
+    journal_path: Optional[str] = None
+    lease: object = field(default=None, repr=False)
+    draining: bool = False
+
+
+class ServeFleet:
+    """Liveness + failover bookkeeping for N serve replicas."""
+
+    def __init__(
+        self,
+        replicas: list[ReplicaHandle],
+        *,
+        lease_ttl_s: float = 3.0,
+        heartbeat_s: float = 1.0,
+        probe_timeout_s: float = 2.0,
+        registry: Optional[MetricsRegistry] = None,
+        health: Optional[HealthState] = None,
+        probe: Optional[Callable[[ReplicaHandle], bool]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas = list(replicas)
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.heartbeat_s = float(heartbeat_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._probe = probe if probe is not None else self._http_probe
+        self._lock = threading.Lock()
+        self._death_cbs: list[Callable[[int], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # One queue index per replica, each its own partition: replica k's
+        # liveness IS the lease on index k. acquire() is only ever called
+        # for a replica whose own index sits in its home partition, so the
+        # queue's steal path never crosses replicas.
+        n = len(self.replicas)
+        self._q = PartitionedTrialQueue(
+            n_items=n, n_replicas=n,
+            partitions=[[k] for k in range(n)],
+            lease_ttl_s=self.lease_ttl_s, clock=clock,
+        )
+        for h in self.replicas:
+            h.lease = self._q.acquire(h.index)
+        self._was_live = set(range(n))
+
+        reg = registry if registry is not None else default_registry()
+        self._g_live = reg.gauge(
+            "iat_fleet_replicas_live",
+            "serve replicas whose heartbeat lease is current",
+        )
+        self._g_live.set(n)
+        self.c_failovers = reg.counter(
+            "iat_fleet_failovers_total",
+            "replica death transitions detected (lease expiry / failed "
+            "probe past TTL) that triggered failover",
+        )
+        if health is not None:
+            health.add_probe("fleet", self.health_probe)
+
+    # -- probing ------------------------------------------------------------
+
+    def _http_probe(self, h: ReplicaHandle) -> bool:
+        try:
+            with urllib.request.urlopen(
+                h.url.rstrip("/") + "/healthz",
+                timeout=self.probe_timeout_s,
+            ) as resp:
+                return resp.status == 200
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    # -- membership ---------------------------------------------------------
+
+    def live_indices(self) -> list[int]:
+        """Replica indices whose heartbeat lease is still outstanding
+        (TTL expiry applied on read — a silent replica drops out of this
+        set within one ``lease_ttl_s`` with no heartbeat sweep needed)."""
+        out_ids = self._q.outstanding_ids()
+        with self._lock:
+            return [
+                h.index for h in self.replicas
+                if not h.draining
+                and h.lease is not None
+                and h.lease.lease_id in out_ids
+            ]
+
+    def handle(self, index: int) -> ReplicaHandle:
+        return self.replicas[int(index)]
+
+    def mark_draining(self, index: int) -> None:
+        """Administrative drain: the replica leaves the routable set NOW
+        (no TTL wait); its death callbacks fire so accepted work replays
+        to the survivors."""
+        with self._lock:
+            self.replicas[int(index)].draining = True
+        self._sweep_transitions()
+
+    def on_death(self, cb: Callable[[int], None]) -> None:
+        """Register a callback fired (from the heartbeat thread) with the
+        index of each replica that transitions out of the live set."""
+        self._death_cbs.append(cb)
+
+    def health_probe(self) -> Optional[str]:
+        """HealthState probe: degraded (503) when any registered,
+        non-draining replica's lease has expired."""
+        live = set(self.live_indices())
+        with self._lock:
+            dead = [
+                h.index for h in self.replicas
+                if not h.draining and h.index not in live
+            ]
+        if dead:
+            return (
+                f"replica lease expired: "
+                f"{','.join(str(k) for k in dead)} "
+                f"(ttl {self.lease_ttl_s}s)"
+            )
+        return None
+
+    # -- heartbeat ----------------------------------------------------------
+
+    def heartbeat_once(self) -> list[int]:
+        """One sweep: probe every non-draining replica, renew the leases
+        of the healthy ones, revive recovered ones, then fire death
+        callbacks for fresh transitions. Returns the live set."""
+        out_ids = self._q.outstanding_ids()
+        for h in self.replicas:
+            if h.draining:
+                continue
+            if not self._probe(h):
+                continue  # no touch: the lease ages toward expiry
+            if h.lease is not None and h.lease.lease_id in out_ids:
+                self._q.touch(h.index)
+            else:
+                # Probe recovered after an expiry: the replica's own index
+                # was requeued to its home partition — take it back.
+                lease = self._q.acquire(h.index)
+                if lease is not None and lease.indices == [h.index]:
+                    h.lease = lease
+                elif lease is not None:  # paranoia: never hold a stolen
+                    self._q.fail(lease)  # index from another replica
+        return self._sweep_transitions()
+
+    def _sweep_transitions(self) -> list[int]:
+        live = self.live_indices()
+        live_set = set(live)
+        self._g_live.set(len(live))
+        with self._lock:
+            died = sorted(self._was_live - live_set)
+            self._was_live = live_set
+        for k in died:
+            self.c_failovers.inc()
+        for k in died:
+            for cb in self._death_cbs:
+                try:
+                    cb(k)
+                except Exception:  # noqa: BLE001 — one cb must not
+                    pass           # silence the rest
+        return live
+
+    def start(self) -> "ServeFleet":
+        if self._thread is not None:
+            raise RuntimeError("fleet heartbeat already started")
+
+        def _loop() -> None:
+            while not self._stop.wait(self.heartbeat_s):
+                try:
+                    self.heartbeat_once()
+                except Exception:  # noqa: BLE001 — heartbeat must survive
+                    pass
+
+        self._thread = threading.Thread(
+            target=_loop, name="fleet-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def stats(self) -> dict:
+        live = self.live_indices()
+        return {
+            "replicas": len(self.replicas),
+            "live": live,
+            "draining": [h.index for h in self.replicas if h.draining],
+            "lease_ttl_s": self.lease_ttl_s,
+            "heartbeat_s": self.heartbeat_s,
+            "queue": self._q.stats.as_stats(),
+        }
+
+
+__all__ = ["ReplicaHandle", "ServeFleet"]

@@ -38,6 +38,19 @@ class RequestError(ValueError):
     """Malformed or unsatisfiable request — maps to HTTP 400."""
 
 
+class DuplicateRequest(RequestError):
+    """Request id already admitted (live or terminal) — maps to HTTP 409.
+
+    The idempotency half of exactly-once submits: a router retrying a
+    submit whose response was lost must NOT double-admit; it gets 409 and
+    fetches the (eventual) result via ``GET /v1/result?rid=`` instead.
+    """
+
+    def __init__(self, rid: str) -> None:
+        super().__init__(f"request id {rid!r} already admitted")
+        self.rid = str(rid)
+
+
 class QuotaError(Exception):
     """Tenant over budget — maps to HTTP 429 + Retry-After."""
 
@@ -167,6 +180,7 @@ class VectorStore:
 
 
 __all__ = [
+    "DuplicateRequest",
     "PRIORITIES",
     "QuotaError",
     "RequestError",

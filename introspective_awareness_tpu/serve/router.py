@@ -1,0 +1,637 @@
+"""The fleet front door: prefix-aware routing with bit-identical failover.
+
+:class:`FleetRouter` is a stdlib HTTP server in front of N serve
+replicas (a :class:`~introspective_awareness_tpu.serve.fleet.ServeFleet`
+tracks their liveness). One ``POST /v1/steer`` contract, same as a
+single replica — clients cannot tell the fleet from one engine, even
+through a replica kill:
+
+- **Routing** scores each live replica by the page mass the prompt
+  shares with what that replica has already been routed — the same
+  host-trie estimator ``runner._paged_route`` uses for its cost model
+  (:class:`~introspective_awareness_tpu.runtime.radix.HostPageTrie`),
+  over CHARACTER pages here because the router has no tokenizer. Tenants
+  with a common system prompt land on the replica whose radix cache
+  already owns those pages; ties break to the least-loaded replica.
+
+- **Failover** leans on the engine's PRNG discipline: decode folds only
+  the request's stream id, so the router pins a fleet-unique stream id
+  on every request it admits, and a re-issue of the same request on ANY
+  replica reproduces the token stream byte-for-byte at temperature 0 AND
+  >0. A relay that loses its connection mid-stream re-issues under the
+  SAME rid and stream id, skips the text already delivered, and forwards
+  the remainder — the client sees one seamless stream.
+
+- **Exactly-once** admission: every submit is retried with the same rid;
+  a replica that already admitted it answers 409 (DuplicateRequest) and
+  the router polls ``GET /v1/result`` instead of double-admitting. When
+  a replica dies, its journal's accepted-but-unfinished requests are
+  re-issued to survivors under their ORIGINAL stream ids (skipping rids
+  with live relays, which fail over in-line), so a drain/kill is
+  bit-identical to never having scaled up.
+
+All router→replica calls ride the shared retry discipline
+(:mod:`~introspective_awareness_tpu.runtime.retry`): jittered backoff
+between failover attempts and a per-replica circuit breaker in front of
+submits.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import quote, unquote
+
+from introspective_awareness_tpu.obs.http import (
+    HealthState,
+    handle_observability_get,
+    send_http,
+)
+from introspective_awareness_tpu.obs.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+from introspective_awareness_tpu.runtime.journal import scan_request_records
+from introspective_awareness_tpu.runtime.radix import HostPageTrie
+from introspective_awareness_tpu.runtime.retry import (
+    CircuitBreaker,
+    backoff_delay,
+)
+from introspective_awareness_tpu.serve.fleet import ServeFleet
+
+# Character-page granularity for the router's shared-prefix estimator:
+# coarse enough that a page is a meaningful chunk of a system prompt,
+# fine enough that family preambles of a few hundred characters score.
+ROUTER_PAGE_CHARS = 64
+# Per-replica trie node bound (see HostPageTrie.max_pages).
+ROUTER_TRIE_MAX_PAGES = 65536
+# Router-assigned stream ids start high so they never collide with ids
+# engines self-assign (grade_texts counts from 0) or tests typically pin.
+ROUTER_STREAM_BASE = 1 << 20
+
+MAX_BODY_BYTES = 1 << 20
+
+
+class ReplicaError(Exception):
+    """Transport-level failure talking to a replica (retryable)."""
+
+
+class ReplicaRejected(Exception):
+    """Application-level rejection (400/429) — forward verbatim."""
+
+    def __init__(self, status: int, body: bytes,
+                 retry_after: Optional[str] = None) -> None:
+        super().__init__(f"replica rejected with {status}")
+        self.status = int(status)
+        self.body = body
+        self.retry_after = retry_after
+
+
+class DuplicateSubmit(Exception):
+    """Replica answered 409: the rid is already admitted there."""
+
+
+class ReplicaStream:
+    """A live ndjson response plus the connection that owns it.
+
+    ``abort()`` exists because closing a response from another thread
+    does NOT interrupt a read already blocked in ``recv`` — only a
+    socket ``shutdown`` does. The death callback aborts relays pinned to
+    a dead replica this way, so failover latency is lease-detection
+    latency, not the stream read timeout. abort() deliberately does NOT
+    close: the reader thread is inside http.client at that moment, and
+    closing under it tears out state mid-parse — shutdown alone makes
+    its read surface EOF (``IncompleteRead``), and the reader's own
+    ``finally`` does the close."""
+
+    def __init__(self, conn: http.client.HTTPConnection, resp) -> None:
+        self._conn = conn
+        self._resp = resp
+
+    def __iter__(self):
+        return iter(self._resp)
+
+    def abort(self) -> None:
+        try:
+            if self._conn.sock is not None:
+                self._conn.sock.shutdown(socket.SHUT_RDWR)
+        except (OSError, AttributeError):
+            pass  # racing the owner thread's close(): already torn down
+
+    def close(self) -> None:
+        try:
+            self._resp.close()
+        except OSError:
+            pass
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class ReplicaClient:
+    """HTTP client for one replica: breaker-fronted submit + result."""
+
+    def __init__(
+        self,
+        url: str,
+        *,
+        timeout_s: float = 300.0,
+        connect_timeout_s: float = 10.0,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 2.0,
+    ) -> None:
+        self.url = url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s,
+        )
+
+    def submit(self, payload: bytes) -> ReplicaStream:
+        """POST the request; return the live chunked-ndjson stream for
+        the caller to iterate. Raises :class:`DuplicateSubmit` (409),
+        :class:`ReplicaRejected` (400/429), :class:`ReplicaError`
+        (breaker open / transport / 5xx)."""
+        if not self.breaker.allow():
+            raise ReplicaError(f"breaker open for {self.url}")
+        host, _, port = self.url.split("//", 1)[1].partition(":")
+        conn = http.client.HTTPConnection(
+            host, int(port) if port else 80, timeout=self.timeout_s)
+        try:
+            conn.request("POST", "/v1/steer", payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+        except (http.client.HTTPException, OSError) as e:
+            conn.close()
+            self.breaker.record_failure()
+            raise ReplicaError(f"{self.url} unreachable: {e}")
+        if resp.status == 200:
+            self.breaker.record_success()
+            return ReplicaStream(conn, resp)
+        body = b""
+        try:
+            body = resp.read()
+        except OSError:
+            pass
+        retry_after = resp.getheader("Retry-After")
+        conn.close()
+        if resp.status == 409:
+            self.breaker.record_success()  # alive and answering
+            raise DuplicateSubmit(body.decode("utf-8", "replace"))
+        if resp.status in (400, 429):
+            self.breaker.record_success()
+            raise ReplicaRejected(resp.status, body, retry_after)
+        self.breaker.record_failure()
+        raise ReplicaError(f"{self.url} answered {resp.status}")
+
+    def fetch_result(self, rid: str) -> tuple[str, Optional[dict]]:
+        """``("done", doc)`` / ``("live", None)`` / ``("unknown", None)``
+        / ``("error", None)`` — never raises."""
+        try:
+            with urllib.request.urlopen(
+                f"{self.url}/v1/result?rid={quote(rid, safe='')}",
+                timeout=self.connect_timeout_s,
+            ) as resp:
+                if resp.status == 200:
+                    return "done", json.loads(resp.read().decode("utf-8"))
+                return "live" if resp.status == 202 else "unknown", None
+        except urllib.error.HTTPError as e:
+            if e.code == 202:
+                return "live", None
+            if e.code == 404:
+                return "unknown", None
+            return "error", None
+        except (urllib.error.URLError, OSError, ValueError):
+            return "error", None
+
+
+class _SeveredStream(Exception):
+    """The replica connection died before the terminal line."""
+
+
+class _ClientGone(Exception):
+    """The CLIENT side of the relay hung up — abort, don't fail over."""
+
+
+class FleetRouter:
+    """Prefix-aware HTTP router over a :class:`ServeFleet`."""
+
+    def __init__(
+        self,
+        fleet: ServeFleet,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        registry: Optional[MetricsRegistry] = None,
+        health: Optional[HealthState] = None,
+        max_failover_attempts: int = 8,
+        result_wait_s: float = 300.0,
+        stream_timeout_s: float = 300.0,
+    ) -> None:
+        self.fleet = fleet
+        self.registry = (registry if registry is not None
+                         else default_registry())
+        self.health = health if health is not None else HealthState()
+        self.max_failover_attempts = int(max_failover_attempts)
+        self.result_wait_s = float(result_wait_s)
+        self._host = host
+        self._want_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._next_stream = ROUTER_STREAM_BASE
+        self._inflight = [0] * len(fleet.replicas)
+        self._tries = [
+            HostPageTrie(ROUTER_PAGE_CHARS, max_pages=ROUTER_TRIE_MAX_PAGES)
+            for _ in fleet.replicas
+        ]
+        self.clients = [
+            ReplicaClient(h.url, timeout_s=stream_timeout_s)
+            for h in fleet.replicas
+        ]
+        # rid -> (replica index, live response) for in-flight relays: a
+        # death event aborts the blocked reads so failover does not wait
+        # out a stream that will never produce another line.
+        self._relays: dict[str, tuple[int, Any]] = {}
+        self._c_routed = self.registry.counter(
+            "iat_router_requests_total",
+            "requests routed, by replica index",
+            labelnames=("replica",),
+        )
+        self._c_failover_reissues = self.registry.counter(
+            "iat_router_failover_reissues_total",
+            "in-flight relays re-issued after a severed replica stream",
+        )
+        self._c_replayed = self.registry.counter(
+            "iat_router_journal_replays_total",
+            "orphaned journaled requests replayed to survivors",
+        )
+        self._g_shared = self.registry.gauge(
+            "iat_router_last_shared_pages",
+            "shared-page score of the most recent routing decision",
+        )
+        fleet.on_death(self._on_replica_death)
+
+    # -- routing ------------------------------------------------------------
+
+    def route(self, prompt: str) -> Optional[int]:
+        """Pick a live replica: max shared-page mass with what it has
+        already been routed, ties to least inflight then lowest index.
+        Inserts the prompt's pages into the winner's trie. None when no
+        replica is live."""
+        live = self.fleet.live_indices()
+        if not live:
+            return None
+        with self._lock:
+            best, best_key = None, None
+            for k in live:
+                shared = self._tries[k].match_pages(prompt)
+                key = (-shared, self._inflight[k], k)
+                if best_key is None or key < best_key:
+                    best, best_key = k, key
+            self._tries[best].walk(prompt)
+            self._inflight[best] += 1
+            self._g_shared.set(-best_key[0])
+        self._c_routed.inc(replica=str(best))
+        return best
+
+    def _release(self, k: int) -> None:
+        with self._lock:
+            self._inflight[k] = max(0, self._inflight[k] - 1)
+
+    def _on_replica_death(self, k: int) -> None:
+        """Fleet death callback: abort relays blocked on the dead
+        replica, reset its (now cold) prefix estimate, and replay its
+        journaled accepted-but-unfinished requests to survivors."""
+        with self._lock:
+            self._tries[k] = HostPageTrie(
+                ROUTER_PAGE_CHARS, max_pages=ROUTER_TRIE_MAX_PAGES)
+            self._inflight[k] = 0
+            blocked = [resp for rid, (rk, resp) in self._relays.items()
+                       if rk == k]
+            active = set(self._relays)
+        for stream in blocked:
+            stream.abort()  # the relay thread's read raises; it re-issues
+        jp = self.fleet.handle(k).journal_path
+        if not jp:
+            return
+        pending, _done = scan_request_records(jp)
+        for rid, spec in pending.items():
+            if rid in active:
+                continue  # its live relay fails over in-line
+            threading.Thread(
+                target=self._replay_orphan, args=(rid, spec),
+                name=f"fleet-replay-{rid[:8]}", daemon=True,
+            ).start()
+
+    def _replay_orphan(self, rid: str, spec: dict) -> None:
+        """Re-issue one orphaned request (client long gone) under its
+        ORIGINAL stream id; the result lands in the survivor's journal
+        and done-cache, where ``/v1/result`` serves it."""
+        body = json.dumps({**spec, "rid": rid}).encode("utf-8")
+        for attempt in range(self.max_failover_attempts):
+            k = self.route(str(spec.get("prompt", "")))
+            if k is None:
+                time.sleep(backoff_delay(attempt, base_s=0.2, ceiling_s=2.0))
+                continue
+            try:
+                resp = self.clients[k].submit(body)
+            except DuplicateSubmit:
+                self._release(k)
+                return  # someone already owns it — exactly-once held
+            except ReplicaRejected:
+                self._release(k)
+                return  # replica refused it for cause; journal keeps it
+            except ReplicaError:
+                self._release(k)
+                time.sleep(backoff_delay(attempt, base_s=0.2, ceiling_s=2.0))
+                continue
+            try:
+                for raw in resp:
+                    doc = json.loads(raw.decode("utf-8"))
+                    if doc.get("done") or "error" in doc:
+                        self._c_replayed.inc()
+                        return
+            except (OSError, ValueError, http.client.HTTPException):
+                continue  # severed again; next attempt
+            finally:
+                self._release(k)
+                resp.close()
+
+    # -- relay --------------------------------------------------------------
+
+    def _relay(self, handler, doc: dict) -> None:
+        """Stream one client request through the fleet, failing over
+        across replica deaths and severed streams. The client-visible
+        stream is the uninterrupted reference: deltas already forwarded
+        are skipped on re-issue (byte-identity makes the skip exact)."""
+        rid = doc["rid"]
+        prompt = str(doc.get("prompt", ""))
+        body = json.dumps(doc).encode("utf-8")
+        headers_sent = False
+        acc = ""        # replica-side cumulative delta text this issue
+        sent_chars = 0  # characters already forwarded to the client
+
+        def _start_response() -> None:
+            nonlocal headers_sent
+            if not headers_sent:
+                handler.send_response(200)
+                handler.send_header("Content-Type", "application/x-ndjson")
+                handler.send_header("Transfer-Encoding", "chunked")
+                handler.end_headers()
+                headers_sent = True
+
+        # Client-side write failures become _ClientGone so the failover
+        # handler below (which catches OSError from REPLICA reads) never
+        # mistakes a hung-up client for a severed replica stream.
+        def _line(d: dict) -> None:
+            try:
+                _start_response()
+                data = json.dumps(d).encode("utf-8") + b"\n"
+                handler.wfile.write(f"{len(data):x}\r\n".encode())
+                handler.wfile.write(data + b"\r\n")
+                handler.wfile.flush()
+            except OSError as e:
+                raise _ClientGone() from e
+
+        def _finish() -> None:
+            try:
+                handler.wfile.write(b"0\r\n\r\n")
+                handler.wfile.flush()
+            except OSError as e:
+                raise _ClientGone() from e
+
+        for attempt in range(self.max_failover_attempts):
+            if attempt:
+                time.sleep(backoff_delay(
+                    attempt - 1, base_s=0.05, ceiling_s=1.0))
+            k = self.route(prompt)
+            if k is None:
+                continue  # nothing live; backoff covers one heartbeat
+            try:
+                resp = self.clients[k].submit(body)
+            except DuplicateSubmit:
+                self._release(k)
+                out = self._await_result(rid)
+                if out is not None:
+                    _line(out)
+                    _finish()
+                    return
+                continue
+            except ReplicaRejected as e:
+                self._release(k)
+                if headers_sent:  # mid-failover; surface as stream error
+                    _line({"error": e.body.decode("utf-8", "replace"),
+                           "rid": rid})
+                    _finish()
+                    return
+                extra = ({"Retry-After": e.retry_after}
+                         if e.retry_after else None)
+                send_http(handler, e.status, "application/json", e.body,
+                          extra_headers=extra)
+                return
+            except ReplicaError:
+                self._release(k)
+                continue
+            with self._lock:
+                self._relays[rid] = (k, resp)
+            acc = ""
+            try:
+                for raw in resp:
+                    rdoc = json.loads(raw.decode("utf-8"))
+                    if "text" in rdoc and not rdoc.get("done"):
+                        acc += rdoc["text"]
+                        if len(acc) > sent_chars:
+                            _line({"text": acc[sent_chars:]})
+                            sent_chars = len(acc)
+                        continue
+                    # Terminal: forward as-is (carries the full text).
+                    _line(rdoc)
+                    _finish()
+                    return
+                raise _SeveredStream(rid)
+            except (_SeveredStream, OSError, ValueError,
+                    http.client.HTTPException):
+                # Severed mid-stream (network fault, replica death, or an
+                # injected drop): re-issue under the same rid/stream id.
+                self._c_failover_reissues.inc()
+                continue
+            finally:
+                with self._lock:
+                    self._relays.pop(rid, None)
+                self._release(k)
+                resp.close()
+        # Attempts exhausted: one last result poll (a parallel replay may
+        # have finished it), then a terminal error line.
+        out = self._await_result(rid, wait_s=1.0)
+        if out is not None:
+            _line(out)
+        else:
+            _line({"error": "no replica could complete the request",
+                   "rid": rid})
+        _finish()
+
+    def _await_result(self, rid: str,
+                      wait_s: Optional[float] = None) -> Optional[dict]:
+        """Poll every live replica's ``/v1/result`` until the rid reaches
+        a terminal doc (it is admitted SOMEWHERE — a 409 proved that) or
+        the deadline passes."""
+        deadline = time.monotonic() + (
+            self.result_wait_s if wait_s is None else wait_s)
+        while time.monotonic() < deadline:
+            live = self.fleet.live_indices()
+            for k in live:
+                state, out = self.clients[k].fetch_result(rid)
+                if state == "done":
+                    return out
+            time.sleep(0.1)
+        return None
+
+    # -- HTTP front door ----------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("FleetRouter not started")
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def fleet_doc(self) -> dict:
+        with self._lock:
+            inflight = list(self._inflight)
+            trie_pages = [t.n_pages for t in self._tries]
+        return {
+            **self.fleet.stats(),
+            "inflight": inflight,
+            "trie_pages": trie_pages,
+            "replica_urls": [h.url for h in self.fleet.replicas],
+        }
+
+    def start(self) -> "FleetRouter":
+        router = self
+        registry, health = self.registry, self.health
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a: Any) -> None:
+                pass
+
+            def do_GET(self) -> None:
+                parts = self.path.split("?", 1)
+                path = parts[0]
+                query = parts[1] if len(parts) > 1 else ""
+                if path == "/v1/result":
+                    rid = ""
+                    for part in query.split("&"):
+                        key, _, v = part.partition("=")
+                        if key == "rid":
+                            rid = unquote(v)
+                    states = [router.clients[k].fetch_result(rid)
+                              for k in router.fleet.live_indices()]
+                    done = next((d for s, d in states if s == "done"), None)
+                    if done is not None:
+                        send_http(self, 200, "application/json",
+                                  json.dumps(done).encode() + b"\n")
+                    elif any(s == "live" for s, _ in states):
+                        send_http(self, 202, "application/json",
+                                  json.dumps({"rid": rid, "live": True}
+                                             ).encode() + b"\n")
+                    else:
+                        send_http(self, 404, "application/json",
+                                  json.dumps({"error": "unknown rid",
+                                              "rid": rid}).encode() + b"\n")
+                    return
+                if not handle_observability_get(
+                    self, path, registry, None, health, query=query,
+                    extra_routes={"/fleet": lambda: (
+                        200, "application/json",
+                        json.dumps(router.fleet_doc()).encode() + b"\n",
+                    )},
+                ):
+                    send_http(self, 404, "text/plain", b"not found\n")
+
+            def do_POST(self) -> None:
+                path = self.path.split("?", 1)[0]
+                if path != "/v1/steer":
+                    send_http(self, 404, "text/plain", b"not found\n")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    n = -1
+                if not (0 < n <= MAX_BODY_BYTES):
+                    send_http(self, 400, "text/plain",
+                              b"missing or oversized body\n")
+                    return
+                try:
+                    doc = json.loads(self.rfile.read(n).decode("utf-8"))
+                    if not isinstance(doc, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, UnicodeDecodeError) as e:
+                    send_http(self, 400, "application/json",
+                              json.dumps({"error": f"bad request: {e}"}
+                                         ).encode() + b"\n")
+                    return
+                # Pin the idempotency key and a fleet-unique stream id
+                # BEFORE first submit, so every retry re-issues the same
+                # logical request (and the same PRNG stream).
+                if not doc.get("rid"):
+                    with router._lock:
+                        router._next_stream += 1
+                        doc["rid"] = f"rt-{router._next_stream:08x}"
+                if doc.get("stream") is None:
+                    with router._lock:
+                        router._next_stream += 1
+                        doc["stream"] = router._next_stream
+                try:
+                    router._relay(self, doc)
+                except _ClientGone:
+                    pass  # client went away; replicas finish regardless
+
+        self._httpd = ThreadingHTTPServer((self._host, self._want_port),
+                                          _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-router",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+__all__ = [
+    "DuplicateSubmit",
+    "FleetRouter",
+    "ReplicaClient",
+    "ReplicaError",
+    "ReplicaRejected",
+    "ROUTER_PAGE_CHARS",
+    "ROUTER_STREAM_BASE",
+]

@@ -20,6 +20,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
+from urllib.parse import unquote
 
 from introspective_awareness_tpu.obs.http import (
     HealthState,
@@ -33,6 +34,7 @@ from introspective_awareness_tpu.obs.registry import (
 )
 from introspective_awareness_tpu.serve.engine import ServeEngine
 from introspective_awareness_tpu.serve.request import (
+    DuplicateRequest,
     QuotaError,
     RequestError,
     parse_request,
@@ -43,7 +45,14 @@ STREAM_IDLE_TIMEOUT_S = 300.0
 
 
 class ServeServer:
-    """HTTP wrapper around one :class:`ServeEngine`."""
+    """HTTP wrapper around one :class:`ServeEngine`.
+
+    ``faults`` (a :class:`~...runtime.faults.FaultPlan`) arms the
+    ``drop_stream_after`` chaos knob: the handler severs the client
+    connection right after the configured streamed line — no terminal
+    document, no chunked trailer — while the engine keeps decoding, the
+    way a routed connection dies under a real mid-stream network fault.
+    """
 
     def __init__(
         self,
@@ -55,8 +64,10 @@ class ServeServer:
         health: Optional[HealthState] = None,
         profiler: Optional[Any] = None,
         trace_source: Optional[Any] = None,
+        faults: Optional[Any] = None,
     ) -> None:
         self.engine = engine
+        self.faults = faults
         self.registry = registry if registry is not None else default_registry()
         self.progress = progress
         self.health = health if health is not None else HealthState()
@@ -81,6 +92,7 @@ class ServeServer:
         engine, registry = self.engine, self.registry
         progress, health = self.progress, self.health
         profiler, trace_source = self.profiler, self.trace_source
+        faults = self.faults
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"  # required for chunked responses
@@ -97,12 +109,41 @@ class ServeServer:
                 parts = self.path.split("?", 1)
                 path = parts[0]
                 query = parts[1] if len(parts) > 1 else ""
+                if path == "/v1/result":
+                    self._result(query)
+                    return
                 if not handle_observability_get(
                     self, path, registry, progress, health,
                     profiler=profiler, trace_source=trace_source,
                     query=query,
                 ):
                     send_http(self, 404, "text/plain", b"not found\n")
+
+            def _result(self, query: str) -> None:
+                """Idempotent result fetch: 200 terminal doc, 202 while the
+                rid is admitted and decoding, 404 for an unknown rid —
+                the read half of exactly-once retried submits."""
+                rid = ""
+                for part in query.split("&"):
+                    k, _, v = part.partition("=")
+                    if k == "rid":
+                        rid = unquote(v)
+                if not rid:
+                    send_http(self, 400, "application/json",
+                              b'{"error": "missing rid"}\n')
+                    return
+                state, doc = engine.result_for(rid)
+                if state == "done":
+                    send_http(self, 200, "application/json",
+                              json.dumps(doc).encode() + b"\n")
+                elif state == "live":
+                    send_http(self, 202, "application/json",
+                              json.dumps({"rid": rid, "live": True}
+                                         ).encode() + b"\n")
+                else:
+                    send_http(self, 404, "application/json",
+                              json.dumps({"error": "unknown rid",
+                                          "rid": rid}).encode() + b"\n")
 
             def do_POST(self) -> None:
                 path = self.path.split("?", 1)[0]
@@ -119,6 +160,13 @@ class ServeServer:
                     return
                 try:
                     stream = engine.submit(parse_request(self.rfile.read(n)))
+                except DuplicateRequest as e:
+                    # Already admitted (live or terminal): never re-admit.
+                    # The retrying router fetches /v1/result instead.
+                    send_http(self, 409, "application/json",
+                              json.dumps({"error": str(e), "rid": e.rid}
+                                         ).encode() + b"\n")
+                    return
                 except QuotaError as e:
                     send_http(
                         self, 429, "application/json",
@@ -149,6 +197,17 @@ class ServeServer:
                         self._chunk(json.dumps(doc).encode() + b"\n")
                     except (BrokenPipeError, ConnectionResetError):
                         return  # client went away; decode continues
+                    if faults is not None and faults.stream_line():
+                        # Injected mid-stream network fault: sever the
+                        # connection with no terminal line and no chunked
+                        # trailer. The engine keeps decoding; the router
+                        # must re-issue (same rid) and hit the 409 path.
+                        self.close_connection = True
+                        try:
+                            self.connection.close()
+                        except OSError:
+                            pass
+                        return
                     if doc.get("done") or "error" in doc:
                         break  # terminal line sent
                 self.wfile.write(b"0\r\n\r\n")

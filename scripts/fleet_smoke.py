@@ -1,0 +1,312 @@
+"""Fleet smoke: the CI chaos lane for the elastic serving fleet
+(README "Serving fleet"), runnable anywhere the tier-1 suite runs:
+
+    JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+
+Phase 1 — mid-load replica kill, temperature 0.7: a 2-replica fleet
+boots with ``crash_after_chunks=4,kill_serve_replica=0`` armed, six
+concurrent sampled requests (pinned stream ids) hit the router, and
+replica 0's scheduler dies mid-decode. Asserts: every request still
+completes through the router; replica 0 drops out of the live set
+within one lease TTL (plus heartbeat slack) of its ``/healthz`` first
+going 503; client-observed p99 TTFT stays non-null through the kill;
+the drain manifest records the crash and at least one failover. Then a
+CLEAN single server re-runs the same requests under the same stream
+ids and every text must be byte-identical — failover re-issue is
+bit-identical even while sampling.
+
+Phase 2 — exactly-once through a severed stream: a fresh 2-replica
+fleet arms ``drop_stream_after=1,kill_serve_replica=0`` (replica 0
+severs its HTTP stream after the first delta line, engine still alive). The router's
+retried submit must land 409 (DuplicateRequest) and deliver the result
+via ``GET /v1/result`` — the replica journals must show the rid admitted
+EXACTLY once across the fleet.
+
+Exit code 0 = both phases hold. Any assertion prints what diverged.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BOOT_TIMEOUT_S = 240.0
+LEASE_TTL_S = 1.5
+HEARTBEAT_S = 0.5
+
+
+class Fleet:
+    """One fleet-mode ``cli serve`` subprocess (router + N replicas)."""
+
+    def __init__(self, out_dir: Path, extra: list[str]) -> None:
+        self.out_dir = out_dir
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "introspective_awareness_tpu.cli", "serve",
+             "--model", "tiny", "--port", "0", "--output-dir", str(out_dir),
+             "--max-wall-s", "600", "--fleet-replicas", "2",
+             "--fleet-lease-ttl-s", str(LEASE_TTL_S),
+             "--fleet-heartbeat-s", str(HEARTBEAT_S), *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd=str(Path(__file__).resolve().parent.parent),
+        )
+        self.port, self.replica_urls = self._await_boot()
+
+    def _await_boot(self) -> tuple[int, list[str]]:
+        deadline = time.monotonic() + BOOT_TIMEOUT_S
+        assert self.proc.stdout is not None
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                raise AssertionError(
+                    f"fleet exited during boot (rc={self.proc.poll()})")
+            if line.startswith("fleet router on "):
+                toks = line.split()
+                port = int(toks[3].split(":")[-1])
+                urls = toks[4].split("=", 1)[1].split(",")
+                return port, urls
+        raise AssertionError("fleet never printed its router port")
+
+    def get_json(self, path: str) -> dict:
+        conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=10)
+        try:
+            conn.request("GET", path)
+            return json.loads(conn.getresponse().read())
+        finally:
+            conn.close()
+
+    def sigterm_drain(self) -> dict:
+        self.proc.send_signal(signal.SIGTERM)
+        rc = self.proc.wait(timeout=300)
+        assert rc == 0, f"SIGTERM drain exited {rc}, want 0"
+        return json.loads((self.out_dir / "run_manifest.json").read_text())
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+
+
+def steer(port: int, doc: dict, timeout_s: float = 300.0) -> dict:
+    """POST one request, drain the stream, return the terminal doc with
+    client-observed TTFT (seconds to the FIRST line) attached."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout_s)
+    t0 = time.monotonic()
+    ttft = None
+    try:
+        conn.request("POST", "/v1/steer", json.dumps(doc).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, f"{resp.status} {resp.read()[:200]!r}"
+        while True:
+            line = resp.readline()
+            assert line, "stream closed without a terminal line"
+            if ttft is None:
+                ttft = time.monotonic() - t0
+            rec = json.loads(line)
+            if rec.get("done") or "error" in rec:
+                rec["_ttft_s"] = ttft
+                return rec
+    finally:
+        conn.close()
+
+
+def healthz_status(url: str) -> int:
+    host, port = url.rsplit(":", 1)[0].split("//")[1], url.rsplit(":", 1)[1]
+    conn = http.client.HTTPConnection(host, int(port), timeout=2)
+    try:
+        conn.request("GET", "/healthz")
+        return conn.getresponse().status
+    except OSError:
+        return -1
+    finally:
+        conn.close()
+
+
+def counter_value(manifest: dict, name: str) -> float:
+    series = manifest["metrics"]["metrics"].get(name, {}).get("series", [])
+    return sum(row["value"] for row in series)
+
+
+SPECS = [
+    {"tenant": "chat", "priority": "interactive", "vector": "demo",
+     "layer": 2, "strength": 2.0, "max_new_tokens": 24,
+     "temperature": 0.7, "stream": 7001 + i, "rid": f"fk-{i}",
+     "prompt": ("fleet shared system preamble, repeated to fill pages. " * 3
+                + f"user turn {i}")}
+    for i in range(6)
+]
+
+
+def phase_kill_drill(base: Path) -> dict:
+    print("[phase 1] mid-load replica kill at temperature 0.7")
+    fleet = Fleet(base / "p1", [
+        "--slots", "2", "--max-new-tokens", "24", "--temperature", "0.7",
+        "--seed", "5",
+        "--inject-faults", "crash_after_chunks=4,kill_serve_replica=0",
+    ])
+    try:
+        victim_url = fleet.replica_urls[0]
+        watch: dict = {"t503": None, "tdead": None}
+        stop_watch = threading.Event()
+
+        def _watch() -> None:
+            # Timestamp the victim's first failing /healthz and its exit
+            # from the router's live set: the gap is the detection latency
+            # the lease TTL promises to bound.
+            while not stop_watch.wait(0.1):
+                if watch["t503"] is None:
+                    if healthz_status(victim_url) != 200:
+                        watch["t503"] = time.monotonic()
+                elif watch["tdead"] is None:
+                    if 0 not in fleet.get_json("/fleet")["live"]:
+                        watch["tdead"] = time.monotonic()
+                        return
+
+        watcher = threading.Thread(target=_watch, daemon=True)
+        watcher.start()
+
+        results: list[dict] = [{} for _ in SPECS]
+        threads = [
+            threading.Thread(
+                target=lambda i=i: results[i].update(
+                    steer(fleet.port, SPECS[i])))
+            for i in range(len(SPECS))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for spec, out in zip(SPECS, results):
+            assert out.get("done"), f"{spec['rid']} failed: {out}"
+            assert out["rid"] == spec["rid"]
+        stop_watch.set()
+        watcher.join(timeout=30)
+
+        assert watch["t503"] is not None, "victim /healthz never went 503"
+        assert watch["tdead"] is not None, "victim never left the live set"
+        detect_s = watch["tdead"] - watch["t503"]
+        bound = LEASE_TTL_S + 2 * HEARTBEAT_S + 1.0
+        assert detect_s <= bound, (
+            f"lease expiry took {detect_s:.2f}s, bound {bound:.2f}s")
+        assert fleet.get_json("/fleet")["live"] == [1]
+
+        ttfts = sorted(out["_ttft_s"] for out in results)
+        p99 = ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+        assert p99 is not None and p99 > 0
+
+        man = fleet.sigterm_drain()
+        assert man["crashed_replicas"] == [0], man["crashed_replicas"]
+        assert counter_value(man, "iat_fleet_failovers_total") >= 1
+        print(f"[phase 1] OK: 6/6 completed through the kill, lease expiry "
+              f"{detect_s:.2f}s <= {bound:.2f}s, ttft p99 {p99:.2f}s")
+    finally:
+        fleet.kill()
+
+    # The clean reference: one healthy single-replica server, same seed,
+    # same pinned stream ids — every failed-over text must match it.
+    print("[phase 1] clean-reference identity check")
+    ref_dir = base / "p1ref"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "introspective_awareness_tpu.cli", "serve",
+         "--model", "tiny", "--port", "0", "--output-dir", str(ref_dir),
+         "--slots", "2", "--max-new-tokens", "24", "--temperature", "0.7",
+         "--seed", "5", "--max-wall-s", "600"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=str(Path(__file__).resolve().parent.parent),
+    )
+    try:
+        port = None
+        deadline = time.monotonic() + BOOT_TIMEOUT_S
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            assert line, f"reference server died (rc={proc.poll()})"
+            if line.startswith("serving on "):
+                port = int(line.split(":")[-1].split()[0])
+                break
+        assert port is not None, "reference server never printed its port"
+        n_identical = 0
+        for spec, out in zip(SPECS, results):
+            ref = steer(port, dict(spec))
+            assert ref.get("done"), ref
+            assert ref["text"] == out["text"], (
+                f"{spec['rid']} diverged from clean reference:\n"
+                f"  fleet: {out['text']!r}\n  ref:   {ref['text']!r}")
+            n_identical += 1
+        print(f"[phase 1] OK: {n_identical}/6 texts byte-identical to the "
+              f"uninterrupted reference (sampled, temperature 0.7)")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    return {"detect_s": detect_s, "ttft_p99_s": p99}
+
+
+def phase_exactly_once(base: Path) -> dict:
+    from introspective_awareness_tpu.runtime.journal import (
+        scan_request_records,
+    )
+
+    print("[phase 2] exactly-once through a severed stream")
+    fleet = Fleet(base / "p2", [
+        "--slots", "2", "--max-new-tokens", "24", "--seed", "7",
+        "--inject-faults", "drop_stream_after=1,kill_serve_replica=0",
+    ])
+    try:
+        # First request at idle ties to replica 0 — the armed one.
+        out = steer(fleet.port, {
+            "tenant": "chat", "priority": "interactive", "vector": "demo",
+            "layer": 2, "strength": 2.0, "max_new_tokens": 24,
+            "stream": 8001, "rid": "p2-once",
+            "prompt": "a prompt long enough to stream several delta lines",
+        })
+        assert out.get("done"), f"request lost in the severed stream: {out}"
+        assert out["rid"] == "p2-once"
+        man = fleet.sigterm_drain()
+        reissues = counter_value(man, "iat_router_failover_reissues_total")
+        assert reissues >= 1, f"router never re-issued (got {reissues})"
+    finally:
+        fleet.kill()
+
+    admitted = 0
+    for k in range(2):
+        path = base / "p2" / f"request_journal.replica{k}.jsonl"
+        if not path.exists():
+            continue
+        pending, done = scan_request_records(path)
+        n = int("p2-once" in pending) + int("p2-once" in done)
+        admitted += n
+        assert "p2-once" not in pending, (
+            f"replica {k} still shows p2-once pending after drain")
+    assert admitted == 1, (
+        f"rid admitted on {admitted} replicas, want exactly 1")
+    print("[phase 2] OK: stream severed, submit retried into 409, result "
+          "delivered, rid admitted exactly once fleet-wide")
+    return {"reissues": reissues}
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="fleet_smoke_") as td:
+        base = Path(td)
+        kill = phase_kill_drill(base)
+        once = phase_exactly_once(base)
+
+    print(json.dumps({
+        "fleet_smoke": "ok",
+        "lease_detect_s": round(kill["detect_s"], 3),
+        "ttft_p99_s": round(kill["ttft_p99_s"], 3),
+        "reissues": once["reissues"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

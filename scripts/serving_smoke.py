@@ -19,7 +19,15 @@ non-null, interactive requests must complete, the stream protocol must
 produce zero errors, and the SIGTERM drain must again exit 0 with the
 serving histograms present in the manifest's metrics snapshot.
 
-Exit code 0 = both phases hold. Any assertion prints what diverged.
+Phase 3 — drain→recover bit-identity at temperature 0.7, over real
+HTTP: a one-slot server is SIGTERMed while a sampled bulk request sits
+admitted-but-queued behind a blocker (its HTTP stream already open).
+The drain journals it; a second server booted on the SAME journal
+recovers it under its original stream id and must decode byte-identical
+text to the uninterrupted reference, delivered through the idempotent
+``GET /v1/result`` read path.
+
+Exit code 0 = all phases hold. Any assertion prints what diverged.
 """
 
 from __future__ import annotations
@@ -196,11 +204,90 @@ def phase_loadgen(base: Path) -> dict:
         srv.kill()
 
 
+def phase_drain_recover_identity(base: Path) -> dict:
+    print("[phase 3] SIGTERM drain -> journal recovery bit-identity "
+          "(temperature 0.7) over HTTP")
+    flags = ["--slots", "1", "--max-new-tokens", "48",
+             "--temperature", "0.7", "--seed", "9"]
+    tgt_spec = {
+        "tenant": "sweep", "priority": "bulk",
+        "prompt": "the recovered request must resume its PRNG identity",
+        "vector": "demo", "layer": 2, "strength": 2.0,
+        "max_new_tokens": 48, "temperature": 0.7, "stream": 777,
+    }
+    srv = Server(base / "p3", flags)
+    try:
+        # Uninterrupted reference under the target's stream id: stream id
+        # (not rid) is the PRNG identity, so this is what the recovered
+        # decode must reproduce byte-for-byte.
+        ref = steer(srv.port, {**tgt_spec, "rid": "p3-ref"})
+        assert ref.get("done"), ref
+
+        # Blocker owns the only slot; the target is then admitted (HTTP
+        # stream open, journaled) but queued — exactly what a SIGTERM
+        # drain leaves behind for the next boot.
+        blk_out: dict = {}
+        blk = threading.Thread(target=lambda: blk_out.update(steer(
+            srv.port, {**tgt_spec, "stream": 801, "rid": "p3-blk",
+                       "prompt": "blocker that holds the slot through "
+                                 "the drain"})))
+        blk.start()
+        time.sleep(0.3)
+        tgt_out: dict = {}
+        tgt = threading.Thread(target=lambda: tgt_out.update(steer(
+            srv.port, {**tgt_spec, "rid": "p3-target"})))
+        tgt.start()
+        time.sleep(1.0)
+
+        man = srv.sigterm_drain()
+        blk.join(timeout=120)
+        tgt.join(timeout=120)
+        assert blk_out.get("done"), f"blocker lost in drain: {blk_out}"
+        assert "error" in tgt_out and "journaled" in tgt_out["error"], (
+            f"target should have been drained to the journal: {tgt_out}")
+        assert man["clean_shutdown"] is True, man
+    finally:
+        srv.kill()
+
+    # Boot 2: same --output-dir, same journal — the target is recovered
+    # under stream id 777 and its result surfaces via GET /v1/result.
+    srv2 = Server(base / "p3", flags)
+    try:
+        deadline = time.monotonic() + 180
+        rec = None
+        while time.monotonic() < deadline:
+            conn = http.client.HTTPConnection("127.0.0.1", srv2.port,
+                                              timeout=10)
+            try:
+                conn.request("GET", "/v1/result?rid=p3-target")
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status == 200:
+                    rec = json.loads(body)
+                    break
+                assert resp.status == 202, (resp.status, body[:200])
+            finally:
+                conn.close()
+            time.sleep(0.5)
+        assert rec is not None, "recovered result never surfaced"
+        assert rec["text"] == ref["text"], (
+            f"recovered decode diverged from uninterrupted reference:\n"
+            f"  recovered: {rec['text']!r}\n  ref:       {ref['text']!r}")
+        srv2.sigterm_drain()
+        print(f"[phase 3] OK: target journaled through SIGTERM, recovered "
+              f"on reboot, {rec['n_tokens']} sampled tokens byte-identical "
+              f"via /v1/result")
+        return {"n_tokens": rec["n_tokens"]}
+    finally:
+        srv2.kill()
+
+
 def main() -> int:
     with tempfile.TemporaryDirectory(prefix="serving_smoke_") as td:
         base = Path(td)
         ident = phase_preemption_identity(base)
         load = phase_loadgen(base)
+        recov = phase_drain_recover_identity(base)
 
     print(json.dumps({
         "serving_smoke": "ok",
@@ -209,6 +296,7 @@ def main() -> int:
         "ttft_p99_s": load["ttft_p99_s"],
         "goodput_evals_per_s": load["serving_goodput_evals_per_s"],
         "rejected_429": load["rejected_429"],
+        "recovered_tokens": recov["n_tokens"],
     }))
     return 0
 

@@ -1,0 +1,331 @@
+"""Tier-1 tests for the elastic serving fleet's host-side pieces — no
+model, no HTTP: FaultPlan's fleet-chaos knobs and their replica scoping,
+the one-shot stream-sever hook, the router's shared-prefix estimator
+(HostPageTrie), ServeFleet's lease lifecycle under a fake clock and an
+injected probe, the FleetRouter routing policy, and the torn-tail
+tolerance of the journal scan the failover replay relies on."""
+
+import pytest
+
+from introspective_awareness_tpu.cli.serve import _scope_faults
+from introspective_awareness_tpu.obs.http import HealthState
+from introspective_awareness_tpu.obs.registry import MetricsRegistry
+from introspective_awareness_tpu.runtime.faults import FaultPlan
+from introspective_awareness_tpu.runtime.journal import (
+    TrialJournal,
+    scan_request_records,
+)
+from introspective_awareness_tpu.runtime.radix import HostPageTrie
+from introspective_awareness_tpu.serve.fleet import ReplicaHandle, ServeFleet
+from introspective_awareness_tpu.serve.router import (
+    ROUTER_PAGE_CHARS,
+    FleetRouter,
+)
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: fleet knobs
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlanFleetKnobs:
+    def test_parses_fleet_spec(self):
+        plan = FaultPlan.from_spec(
+            "crash_after_chunks=4,kill_serve_replica=1,drop_stream_after=2"
+        )
+        assert plan.crash_after_chunks == 4
+        assert plan.kill_serve_replica == 1
+        assert plan.drop_stream_after == 2
+
+    def test_bare_key_means_one(self):
+        assert FaultPlan.from_spec("drop_stream_after").drop_stream_after == 1
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault"):
+            FaultPlan.from_spec("kill_serve_fleet=1")
+
+    def test_duplicate_key_rejected(self):
+        with pytest.raises(ValueError, match="twice"):
+            FaultPlan.from_spec("drop_stream_after=1,drop_stream_after=2")
+
+    def test_non_integer_rejected(self):
+        with pytest.raises(ValueError, match="integer"):
+            FaultPlan.from_spec("kill_serve_replica=zero")
+
+    def test_scope_to_named_replica(self):
+        # kill_serve_replica=1 arms the plan on replica 1 only; every
+        # other replica runs with faults=None.
+        plan = FaultPlan.from_spec("crash_after_chunks=4,kill_serve_replica=1")
+        assert _scope_faults(plan, 0) is None
+        assert _scope_faults(plan, 1) is plan
+        assert _scope_faults(plan, 2) is None
+
+    def test_unscoped_plan_arms_every_replica(self):
+        plan = FaultPlan.from_spec("crash_after_chunks=4")
+        assert _scope_faults(plan, 0) is plan
+        assert _scope_faults(plan, 1) is plan
+
+    def test_none_plan_passes_through(self):
+        assert _scope_faults(None, 0) is None
+
+
+class TestStreamLineHook:
+    def test_fires_exactly_once_on_the_nth_line(self):
+        plan = FaultPlan.from_spec("drop_stream_after=2")
+        assert plan.stream_line() is False   # line 1
+        assert plan.stream_line() is True    # line 2: sever NOW
+        # One-shot: the replica must not keep severing retried streams,
+        # or the router's re-issue path could never deliver.
+        assert all(plan.stream_line() is False for _ in range(5))
+
+    def test_disabled_never_fires(self):
+        plan = FaultPlan()
+        assert all(plan.stream_line() is False for _ in range(5))
+
+
+# ---------------------------------------------------------------------------
+# HostPageTrie: the router's shared-prefix estimator
+# ---------------------------------------------------------------------------
+
+
+class TestHostPageTrie:
+    def test_walk_inserts_then_match_counts(self):
+        t = HostPageTrie(4)
+        assert t.match_pages("aaaabbbb") == 0
+        t.walk("aaaabbbbcccc")
+        assert t.match_pages("aaaabbbb") == 2
+        assert t.match_pages("aaaabbbbcccc") == 3
+        assert t.n_pages == 3
+
+    def test_match_requires_contiguous_prefix(self):
+        # The scheduler tree's exact-prefix rule: a page counts only
+        # while every page before it matched too.
+        t = HostPageTrie(4)
+        t.walk("aaaabbbbcccc")
+        assert t.match_pages("aaaaZZZZcccc") == 1
+
+    def test_partial_trailing_page_ignored(self):
+        t = HostPageTrie(4)
+        t.walk("aaaabb")  # one full page + a partial
+        assert t.n_pages == 1
+        assert t.match_pages("aaaabb") == 1
+
+    def test_match_pages_is_pure_lookup(self):
+        t = HostPageTrie(4)
+        t.match_pages("aaaabbbb")
+        assert t.n_pages == 0
+
+    def test_max_pages_caps_growth(self):
+        # Long-lived router tries stop inserting at the cap instead of
+        # growing with total traffic — lookups still work on what's in.
+        t = HostPageTrie(4, max_pages=2)
+        t.walk("aaaabbbbcccc")
+        assert t.n_pages == 2
+        t.walk("ddddeeee")
+        assert t.n_pages == 2
+        assert t.match_pages("aaaabbbb") == 2
+        assert t.match_pages("dddd") == 0
+
+
+# ---------------------------------------------------------------------------
+# ServeFleet: lease lifecycle under a fake clock
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _make_fleet(n=2, ttl=3.0):
+    clk = _Clock()
+    healthy = {k: True for k in range(n)}
+    reg = MetricsRegistry()
+    health = HealthState()
+    fleet = ServeFleet(
+        [ReplicaHandle(k, f"http://127.0.0.1:{9000 + k}") for k in range(n)],
+        lease_ttl_s=ttl,
+        heartbeat_s=0.1,
+        registry=reg,
+        health=health,
+        probe=lambda h: healthy[h.index],
+        clock=clk,
+    )
+    return fleet, clk, healthy, reg, health
+
+
+class TestServeFleetLeases:
+    def test_boot_all_live(self):
+        fleet, _clk, _healthy, reg, health = _make_fleet()
+        assert fleet.live_indices() == [0, 1]
+        assert reg.value("iat_fleet_replicas_live") == 2
+        assert health.reasons() == []
+
+    def test_heartbeat_renews_and_silence_expires(self):
+        fleet, clk, healthy, reg, _h = _make_fleet(ttl=3.0)
+        healthy[0] = False
+        # Replica 1 keeps heartbeating; replica 0's lease just ages.
+        for _ in range(4):
+            clk.t += 1.0
+            fleet.heartbeat_once()
+        # Expiry is applied on read — no sweep needed for the drop.
+        assert fleet.live_indices() == [1]
+
+    def test_death_transition_fires_callbacks_once(self):
+        fleet, clk, healthy, reg, health = _make_fleet(ttl=3.0)
+        deaths = []
+        fleet.on_death(deaths.append)
+        healthy[0] = False
+        clk.t = 3.1
+        fleet.heartbeat_once()
+        assert deaths == [0]
+        assert reg.value("iat_fleet_failovers_total") == 1
+        assert reg.value("iat_fleet_replicas_live") == 1
+        assert any("replica lease expired: 0" in r for r in health.reasons())
+        # A second sweep is not a second death.
+        clk.t = 3.2
+        fleet.heartbeat_once()
+        assert deaths == [0]
+        assert reg.value("iat_fleet_failovers_total") == 1
+
+    def test_recovered_probe_rejoins(self):
+        fleet, clk, healthy, reg, health = _make_fleet(ttl=3.0)
+        healthy[0] = False
+        clk.t = 3.1
+        fleet.heartbeat_once()
+        assert fleet.live_indices() == [1]
+        healthy[0] = True
+        clk.t = 3.2
+        fleet.heartbeat_once()  # re-acquires its own partition's index
+        assert fleet.live_indices() == [0, 1]
+        assert health.reasons() == []
+        # The revival keeps its home index — never a stolen one.
+        assert fleet.handle(0).lease.indices == [0]
+
+    def test_mark_draining_leaves_immediately(self):
+        fleet, _clk, _healthy, reg, _h = _make_fleet()
+        deaths = []
+        fleet.on_death(deaths.append)
+        fleet.mark_draining(0)
+        # No TTL wait: administrative drain is an instant transition.
+        assert fleet.live_indices() == [1]
+        assert deaths == [0]
+        assert fleet.stats()["draining"] == [0]
+
+    def test_death_callback_exceptions_do_not_mask_others(self):
+        fleet, clk, healthy, _reg, _h = _make_fleet(ttl=3.0)
+        seen = []
+        fleet.on_death(lambda k: (_ for _ in ()).throw(RuntimeError("boom")))
+        fleet.on_death(seen.append)
+        healthy[0] = False
+        clk.t = 3.1
+        fleet.heartbeat_once()
+        assert seen == [0]
+
+    def test_empty_fleet_rejected(self):
+        with pytest.raises(ValueError):
+            ServeFleet([], registry=MetricsRegistry())
+
+
+# ---------------------------------------------------------------------------
+# FleetRouter: routing policy (no HTTP server started)
+# ---------------------------------------------------------------------------
+
+
+def _make_router(n=2):
+    fleet, clk, healthy, reg, _h = _make_fleet(n=n)
+    router = FleetRouter(fleet, registry=reg)
+    return router, fleet, clk, healthy, reg
+
+
+PAGE = ROUTER_PAGE_CHARS
+
+
+class TestRouterPolicy:
+    def test_idle_tie_breaks_to_lowest_index(self):
+        router, *_ = _make_router()
+        assert router.route("x" * (2 * PAGE)) == 0
+
+    def test_prefix_affinity_beats_least_inflight(self):
+        router, _fleet, _clk, _healthy, reg = _make_router()
+        shared = "s" * (2 * PAGE)
+        assert router.route(shared + "tail-a") == 0
+        # Replica 0 now has 1 inflight and replica 1 none, but the shared
+        # two-page prefix must still win.
+        assert router.route(shared + "tail-b") == 0
+        assert reg.value("iat_router_last_shared_pages") == 2
+        assert reg.value("iat_router_requests_total", replica="0") == 2
+
+    def test_no_shared_pages_spreads_by_inflight(self):
+        router, *_ = _make_router()
+        assert router.route("a" * (2 * PAGE)) == 0
+        assert router.route("b" * (2 * PAGE)) == 1
+
+    def test_release_decrements_inflight(self):
+        router, *_ = _make_router()
+        k = router.route("c" * PAGE + "unique-tail-1")
+        router._release(k)
+        # Fresh prompt, no shared pages: both replicas back at 0
+        # inflight, so the tie again breaks to replica 0.
+        assert router.route("d" * (2 * PAGE)) == 0
+
+    def test_dead_replica_not_routed_and_trie_reset(self):
+        router, fleet, clk, healthy, _reg = _make_router()
+        shared = "s" * (2 * PAGE)
+        assert router.route(shared + "tail-a") == 0
+        healthy[0] = False
+        clk.t = 3.1
+        fleet.heartbeat_once()  # death cb resets replica 0's trie
+        assert router.route(shared + "tail-b") == 1
+        # Revival comes back cold: no phantom prefix credit for pages
+        # routed before the death.
+        healthy[0] = True
+        clk.t = 3.2
+        fleet.heartbeat_once()
+        assert router._tries[0].match_pages(shared) == 0
+
+    def test_no_live_replica_routes_none(self):
+        router, fleet, clk, healthy, _reg = _make_router()
+        healthy[0] = healthy[1] = False
+        clk.t = 3.1
+        fleet.heartbeat_once()
+        assert router.route("x" * PAGE) is None
+
+
+# ---------------------------------------------------------------------------
+# scan_request_records: the failover replay work list
+# ---------------------------------------------------------------------------
+
+
+class TestScanRequestRecords:
+    def _journal(self, tmp_path):
+        return TrialJournal(tmp_path / "req.jsonl", {"kind": "serve"})
+
+    def test_pending_excludes_done(self, tmp_path):
+        j = self._journal(tmp_path)
+        j.record_request("r1", {"prompt": "a"})
+        j.record_request("r2", {"prompt": "b"})
+        j.record_request_done("r1", {"text": "out-a"})
+        j.close()
+        pending, done = scan_request_records(tmp_path / "req.jsonl")
+        assert list(pending) == ["r2"]
+        assert pending["r2"] == {"prompt": "b"}
+        assert done["r1"]["text"] == "out-a"
+
+    def test_torn_tail_skipped_not_fatal(self, tmp_path):
+        # A replica killed mid-append leaves a sheared final line; the
+        # router's scan must keep every intact record and never raise.
+        j = self._journal(tmp_path)
+        j.record_request("r1", {"prompt": "a"})
+        j.record_request("r2", {"prompt": "b"})
+        j.close()
+        path = tmp_path / "req.jsonl"
+        FaultPlan.from_spec("torn_tail").tear_tail(path)
+        pending, _done = scan_request_records(path)
+        assert list(pending) == ["r1"]
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert scan_request_records(tmp_path / "nope.jsonl") == ({}, {})

@@ -1,0 +1,174 @@
+"""Tier-1 tests for runtime/retry.py — the shared retry discipline every
+HTTP client in the tree (fabric transport, judge client, grade pools,
+fleet router) builds on: jittered exponential backoff, Retry-After
+extraction with clamping, and the consecutive-failure circuit breaker."""
+
+import pytest
+
+from introspective_awareness_tpu.runtime.retry import (
+    CircuitBreaker,
+    backoff_delay,
+    retry_after_seconds,
+)
+
+
+# ---------------------------------------------------------------------------
+# backoff_delay
+# ---------------------------------------------------------------------------
+
+
+class TestBackoffDelay:
+    def test_exponential_shape(self):
+        no_jitter = lambda a, b: 0.0  # noqa: E731
+        delays = [backoff_delay(a, base_s=0.5, rng=no_jitter)
+                  for a in range(4)]
+        assert delays == [0.5, 1.0, 2.0, 4.0]
+
+    def test_ceiling_clamps(self):
+        no_jitter = lambda a, b: 0.0  # noqa: E731
+        assert backoff_delay(10, base_s=1.0, ceiling_s=7.0,
+                             rng=no_jitter) == 7.0
+
+    def test_retry_after_lifts_over_ceiling(self):
+        # The server's Retry-After wins over the local ceiling — the
+        # server knows when it will take traffic again.
+        no_jitter = lambda a, b: 0.0  # noqa: E731
+        assert backoff_delay(0, base_s=1.0, ceiling_s=2.0, retry_after=9.0,
+                             rng=no_jitter) == 9.0
+
+    def test_retry_after_below_delay_is_ignored(self):
+        no_jitter = lambda a, b: 0.0  # noqa: E731
+        assert backoff_delay(3, base_s=1.0, retry_after=0.5,
+                             rng=no_jitter) == 8.0
+
+    def test_jitter_bounds(self):
+        # rng is called with (0, jitter_frac * delay); a max-jitter rng
+        # bounds the total at delay * (1 + jitter_frac).
+        max_jitter = lambda a, b: b  # noqa: E731
+        d = backoff_delay(2, base_s=1.0, jitter_frac=0.25, rng=max_jitter)
+        assert d == pytest.approx(4.0 * 1.25)
+
+
+# ---------------------------------------------------------------------------
+# retry_after_seconds
+# ---------------------------------------------------------------------------
+
+
+class _FakeResp:
+    def __init__(self, headers):
+        self.headers = headers
+
+
+class _FakeErr(Exception):
+    def __init__(self, headers=None):
+        super().__init__("fake")
+        if headers is not None:
+            self.response = _FakeResp(headers)
+
+
+class TestRetryAfterSeconds:
+    def test_extracts_delta_seconds(self):
+        assert retry_after_seconds(_FakeErr({"retry-after": "17"})) == 17.0
+
+    def test_header_case_variants(self):
+        assert retry_after_seconds(_FakeErr({"Retry-After": "3"})) == 3.0
+
+    def test_clamped_to_ceiling(self):
+        # A server asking for an hour must not stall the caller: the
+        # value is clamped to clamp_s (default 120).
+        assert retry_after_seconds(_FakeErr({"retry-after": "3600"})) == 120.0
+        assert retry_after_seconds(
+            _FakeErr({"retry-after": "3600"}), clamp_s=5.0) == 5.0
+
+    def test_negative_clamped_to_zero(self):
+        assert retry_after_seconds(_FakeErr({"retry-after": "-4"})) == 0.0
+
+    def test_missing_or_unparseable_is_none(self):
+        assert retry_after_seconds(_FakeErr()) is None
+        assert retry_after_seconds(_FakeErr({})) is None
+        # HTTP-date form is deliberately not parsed (a wrong parse would
+        # oversleep), and garbage must not raise.
+        assert retry_after_seconds(
+            _FakeErr({"retry-after": "Wed, 21 Oct 2026 07:28:00 GMT"})
+        ) is None
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestCircuitBreaker:
+    def test_closed_allows_and_failures_below_threshold_stay_closed(self):
+        br = CircuitBreaker(failure_threshold=3, cooldown_s=10.0,
+                            clock=_Clock())
+        assert br.state == "closed"
+        assert br.allow()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"
+        assert br.allow()
+        assert br.consecutive_failures == 2
+
+    def test_success_resets_the_failure_streak(self):
+        br = CircuitBreaker(failure_threshold=2, cooldown_s=10.0,
+                            clock=_Clock())
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == "closed"  # streak broken, never tripped
+
+    def test_trips_open_at_threshold_and_rejects(self):
+        clk = _Clock()
+        br = CircuitBreaker(failure_threshold=2, cooldown_s=10.0, clock=clk)
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "open"
+        assert br.tripped
+        assert not br.allow()
+        clk.t = 9.9
+        assert not br.allow()  # still cooling down
+
+    def test_half_open_single_probe_then_close(self):
+        clk = _Clock()
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, clock=clk)
+        br.record_failure()
+        clk.t = 5.0
+        assert br.state == "half-open"
+        assert br.allow()        # the one probe
+        assert not br.allow()    # concurrent callers stay rejected
+        br.record_success()
+        assert br.state == "closed"
+        assert br.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        clk = _Clock()
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, clock=clk)
+        br.record_failure()
+        clk.t = 5.0
+        assert br.allow()
+        br.record_failure()      # probe failed: re-trip at t=5
+        assert br.state == "open"
+        assert not br.allow()
+        clk.t = 9.9
+        assert not br.allow()    # cooldown restarts from the re-trip
+        clk.t = 10.0
+        assert br.allow()
+
+    def test_record_convenience_wrapper(self):
+        clk = _Clock()
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, clock=clk)
+        br.record(False)
+        assert br.tripped
+        clk.t = 5.0
+        assert br.allow()
+        br.record(True)
+        assert br.state == "closed"
